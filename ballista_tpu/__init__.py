@@ -1,0 +1,29 @@
+"""ballista_tpu: a TPU-native distributed query framework.
+
+A from-scratch re-design of the capability surface of ballista-compute/ballista
+(distributed SQL / DataFrame engine on Arrow) for TPU hardware:
+
+- Arrow (pyarrow / Arrow C++) is the host memory substrate and wire format,
+  playing the role arrow-rs plays for the reference.
+- The query-engine layer (the role DataFusion plays for the reference:
+  logical plans, SQL, optimizer, physical operators) is built here, with two
+  interchangeable kernel backends: a host Arrow backend (correctness oracle,
+  default) and a JAX/XLA backend that lowers operators onto TPU.
+- The distributed layer mirrors the reference's split (scheduler control plane
+  over gRPC + executor data plane over Arrow Flight, reference
+  rust/scheduler/src/lib.rs, rust/executor/src/flight_service.rs) but
+  restructures *execution* around XLA's SPMD model: a query stage can compile
+  to ONE pjit program over a jax.sharding.Mesh, with repartition exchanges
+  expressed as in-program all_to_all collectives over ICI instead of
+  materialize-then-fetch.
+"""
+
+BALLISTA_TPU_VERSION = "0.1.0"
+
+
+def print_version() -> None:
+    # Reference: rust/core/src/lib.rs:26-31
+    print(f"Ballista-TPU version: {BALLISTA_TPU_VERSION}")
+
+
+from ballista_tpu.errors import BallistaError  # noqa: E402,F401

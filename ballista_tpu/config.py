@@ -1,0 +1,87 @@
+"""Session / executor configuration.
+
+The reference flows a free-form string settings map from clients
+(KeyValuePair settings, reference rust/core/proto/ballista.proto:428-447;
+``batch.size`` set by the TPC-H harness, rust/benchmarks/tpch/src/main.rs:120-121)
+and configures daemons via configure_me specs
+(rust/executor/executor_config_spec.toml, rust/scheduler/scheduler_config_spec.toml).
+
+Here both collapse into one typed-view-over-strings config object. The
+executor-selection boundary (cpu | tpu backend) lives here, keeping the host
+Arrow path the default as the reference's CPU executor path is.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Mapping, Optional
+
+BALLISTA_BATCH_SIZE = "ballista.batch.size"
+BALLISTA_BACKEND = "ballista.executor.backend"  # "cpu" (Arrow host kernels) | "tpu" (JAX/XLA)
+BALLISTA_STAGE_FUSION = "ballista.tpu.stage_fusion"  # whole-stage SPMD compilation on/off
+BALLISTA_MESH_SHAPE = "ballista.tpu.mesh"  # e.g. "data:8" or "data:4,model:2"
+BALLISTA_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
+
+DEFAULT_SETTINGS: Dict[str, str] = {
+    # 32768 is the reference's hard-coded default batch size
+    # (rust/core/src/serde/physical_plan/from_proto.rs:100-102).
+    BALLISTA_BATCH_SIZE: "32768",
+    BALLISTA_BACKEND: "cpu",
+    BALLISTA_STAGE_FUSION: "true",
+    BALLISTA_MESH_SHAPE: "data:1",
+    BALLISTA_SHUFFLE_PARTITIONS: "16",
+}
+
+
+class BallistaConfig(Mapping[str, str]):
+    """Immutable string->string settings map with typed accessors."""
+
+    def __init__(self, settings: Optional[Mapping[str, str]] = None) -> None:
+        merged = dict(DEFAULT_SETTINGS)
+        if settings:
+            merged.update({str(k): str(v) for k, v in settings.items()})
+        self._settings = merged
+
+    # Mapping interface ----------------------------------------------------
+    def __getitem__(self, key: str) -> str:
+        return self._settings[key]
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._settings)
+
+    def __len__(self) -> int:
+        return len(self._settings)
+
+    # Typed accessors ------------------------------------------------------
+    def batch_size(self) -> int:
+        return int(self._settings[BALLISTA_BATCH_SIZE])
+
+    def backend(self) -> str:
+        return self._settings[BALLISTA_BACKEND]
+
+    def stage_fusion(self) -> bool:
+        return self._settings[BALLISTA_STAGE_FUSION].lower() in ("1", "true", "yes")
+
+    def shuffle_partitions(self) -> int:
+        return int(self._settings[BALLISTA_SHUFFLE_PARTITIONS])
+
+    def mesh_shape(self) -> Dict[str, int]:
+        """Parse "data:4,model:2" into {"data": 4, "model": 2}."""
+        out: Dict[str, int] = {}
+        for part in self._settings[BALLISTA_MESH_SHAPE].split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, n = part.partition(":")
+            out[name.strip()] = int(n)
+        return out
+
+    def with_setting(self, key: str, value: str) -> "BallistaConfig":
+        s = dict(self._settings)
+        s[key] = value
+        return BallistaConfig(s)
+
+    def to_dict(self) -> Dict[str, str]:
+        return dict(self._settings)
+
+    def __repr__(self) -> str:
+        return f"BallistaConfig({self._settings!r})"

@@ -1,0 +1,1 @@
+from ballista_tpu.engine.context import ExecutionContext, DataFrame  # noqa: F401

@@ -1,0 +1,47 @@
+"""Error types.
+
+Mirrors the reference's single error enum with per-subsystem variants
+(reference rust/core/src/error.rs:30-163) as a small exception hierarchy.
+"""
+
+from __future__ import annotations
+
+
+class BallistaError(Exception):
+    """Base error for all ballista_tpu failures."""
+
+
+class NotImplementedError_(BallistaError):
+    """Feature not implemented (reference error.rs NotImplemented variant)."""
+
+
+class InternalError(BallistaError):
+    """Invariant violation inside the engine."""
+
+
+class PlanError(BallistaError):
+    """Logical/physical planning failure (reference DataFusionError role)."""
+
+
+class SchemaError(BallistaError):
+    """Schema mismatch / unknown column."""
+
+
+class SqlError(BallistaError):
+    """SQL lex/parse/plan failure (reference error.rs Sql variant)."""
+
+
+class SerdeError(BallistaError):
+    """Plan (de)serialization failure."""
+
+
+class IoError(BallistaError):
+    """Filesystem / IPC failure (reference error.rs Io variant)."""
+
+
+class RpcError(BallistaError):
+    """Control-plane (gRPC) failure (reference Tonic/Grpc variants)."""
+
+
+class ExecutionError(BallistaError):
+    """Runtime failure while executing a physical plan."""

@@ -1,0 +1,35 @@
+"""Backend dispatch: route operator compute to JAX/XLA kernels.
+
+Each hook returns None when the JAX kernel set is unavailable (or declines
+the shape); operators then fall back to the host Arrow path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import pyarrow as pa
+
+
+def _kernels():
+    try:
+        from ballista_tpu.ops import kernels
+
+        return kernels
+    except ImportError:
+        return None
+
+
+def tpu_filter(batch: pa.RecordBatch, predicate) -> Optional[pa.RecordBatch]:
+    k = _kernels()
+    return k.filter_batch(batch, predicate) if k else None
+
+
+def tpu_project(batch: pa.RecordBatch, exprs, schema: pa.Schema) -> Optional[pa.RecordBatch]:
+    k = _kernels()
+    return k.project_batch(batch, exprs, schema) if k else None
+
+
+def tpu_hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
+    k = _kernels()
+    return k.hash_aggregate(exec_node, partition, ctx) if k else None

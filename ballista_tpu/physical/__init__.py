@@ -1,0 +1,5 @@
+from ballista_tpu.physical.plan import (  # noqa: F401
+    ExecutionPlan,
+    Partitioning,
+    TaskContext,
+)

@@ -1,0 +1,205 @@
+"""Physical planner: logical plan -> execution plan.
+
+Plays the role DataFusion's DefaultPhysicalPlanner plays for the reference
+(invoked at rust/scheduler/src/lib.rs:325-331). Key structural choices match
+the reference engine's:
+
+- aggregates plan as Partial (per partition) -> Merge -> Final, the shape the
+  distributed planner later cuts into stages (rust/scheduler/src/planner.rs:149-171)
+- sorts and global limits merge partitions first (MergeExec)
+- hash joins collect-left build; LEFT/FULL joins merge the probe side
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+
+from ballista_tpu.datasource import (
+    CsvTableSource,
+    MemoryTableSource,
+    ParquetTableSource,
+)
+from ballista_tpu.errors import PlanError
+from ballista_tpu.logical import expr as lx
+from ballista_tpu.logical import plan as lp
+from ballista_tpu.physical.aggregate import AggregateFunc, AggregateMode, HashAggregateExec
+from ballista_tpu.physical.basic import (
+    CoalesceBatchesExec,
+    EmptyExec,
+    FilterExec,
+    GlobalLimitExec,
+    LocalLimitExec,
+    MergeExec,
+    ProjectionExec,
+    SortExec,
+)
+from ballista_tpu.physical.expr import ColumnExpr, LiteralExpr, create_physical_expr
+from ballista_tpu.physical.join import CrossJoinExec, HashJoinExec
+from ballista_tpu.physical.plan import ExecutionPlan, Partitioning
+from ballista_tpu.physical.repartition import RepartitionExec
+from ballista_tpu.physical.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
+from ballista_tpu.physical.union import UnionExec
+
+
+class PhysicalPlanner:
+    def __init__(self, batch_size: int = 32768) -> None:
+        self.batch_size = batch_size
+
+    def create_physical_plan(self, plan: lp.LogicalPlan) -> ExecutionPlan:
+        p = self._plan(plan)
+        # schema parity check: physical output must match logical
+        lnames = plan.schema().names
+        pnames = p.schema().names
+        if lnames != pnames:
+            raise PlanError(
+                f"physical schema {pnames} != logical schema {lnames}\n{plan}\n{p}"
+            )
+        return p
+
+    # ------------------------------------------------------------------
+    def _plan(self, plan: lp.LogicalPlan) -> ExecutionPlan:
+        if isinstance(plan, lp.TableScan):
+            return self._plan_scan(plan)
+        if isinstance(plan, lp.Projection):
+            input = self._plan(plan.input)
+            in_schema = input.schema()
+            exprs = [
+                (create_physical_expr(e, in_schema), e.output_name())
+                for e in plan.exprs
+            ]
+            return ProjectionExec(input, exprs)
+        if isinstance(plan, lp.Filter):
+            input = self._plan(plan.input)
+            pred = create_physical_expr(plan.predicate, input.schema())
+            return FilterExec(input, pred)
+        if isinstance(plan, lp.Aggregate):
+            return self._plan_aggregate(plan)
+        if isinstance(plan, lp.Distinct):
+            # DISTINCT = group by all columns with no aggregates; alias each
+            # key to its full (possibly qualified) field name so the output
+            # schema matches the logical Distinct exactly
+            group_exprs = []
+            for f in plan.input.schema():
+                bare = f.name.split(".")[-1]
+                rel = f.name.split(".")[0] if "." in f.name else None
+                group_exprs.append(lx.Alias(lx.Column(bare, rel), f.name))
+            agg = lp.Aggregate(plan.input, group_exprs, [])
+            return self._plan_aggregate(agg)
+        if isinstance(plan, lp.Sort):
+            input = self._plan(plan.input)
+            if input.output_partitioning().partition_count() > 1:
+                input = MergeExec(input)
+            keys = [
+                (
+                    create_physical_expr(se.expr, input.schema()),
+                    se.ascending,
+                    se.nulls_first,
+                )
+                for se in plan.sort_exprs
+            ]
+            return SortExec(input, keys)
+        if isinstance(plan, lp.Limit):
+            input = self._plan(plan.input)
+            if input.output_partitioning().partition_count() > 1:
+                input = MergeExec(LocalLimitExec(input, plan.skip + plan.n))
+            return GlobalLimitExec(input, plan.n, plan.skip)
+        if isinstance(plan, lp.Join):
+            return self._plan_join(plan)
+        if isinstance(plan, lp.CrossJoin):
+            return CrossJoinExec(self._plan(plan.left), self._plan(plan.right))
+        if isinstance(plan, lp.Repartition):
+            input = self._plan(plan.input)
+            if plan.scheme == lp.PartitionScheme.HASH:
+                exprs = [create_physical_expr(e, input.schema()) for e in plan.hash_exprs]
+                return RepartitionExec(input, Partitioning.hash(exprs, plan.n))
+            return RepartitionExec(input, Partitioning.round_robin(plan.n))
+        if isinstance(plan, lp.EmptyRelation):
+            return EmptyExec(plan.produce_one_row, plan.schema())
+        if isinstance(plan, lp.SubqueryAlias):
+            input = self._plan(plan.input)
+            # zero-copy rename projection to the qualified names
+            exprs = [
+                (ColumnExpr(f.name, i), plan.schema().field(i).name)
+                for i, f in enumerate(input.schema())
+            ]
+            return ProjectionExec(input, exprs)
+        if isinstance(plan, lp.Union):
+            return UnionExec([self._plan(c) for c in plan.inputs])
+        raise PlanError(f"no physical plan for {type(plan).__name__}")
+
+    # ------------------------------------------------------------------
+    def _plan_scan(self, plan: lp.TableScan) -> ExecutionPlan:
+        src = plan.source
+        if isinstance(src, CsvTableSource):
+            return CsvScanExec(src, plan.projection)
+        if isinstance(src, ParquetTableSource):
+            return ParquetScanExec(src, plan.projection)
+        if isinstance(src, MemoryTableSource):
+            return MemoryScanExec(src, plan.projection)
+        raise PlanError(f"unknown table source {type(src).__name__}")
+
+    # ------------------------------------------------------------------
+    def _plan_aggregate(self, plan: lp.Aggregate) -> ExecutionPlan:
+        input = self._plan(plan.input)
+        in_schema = input.schema()
+        group_exprs = [
+            (create_physical_expr(e, in_schema), e.output_name())
+            for e in plan.group_exprs
+        ]
+        funcs: List[AggregateFunc] = []
+        any_distinct = False
+        for e in plan.aggr_exprs:
+            agg = e
+            if isinstance(agg, lx.Alias):
+                agg = agg.expr
+            if not isinstance(agg, lx.AggregateExpr):
+                raise PlanError(f"aggregate list entry is not an aggregate: {e}")
+            if agg.distinct:
+                if agg.fn != "count":
+                    raise PlanError(
+                        f"DISTINCT is only supported for COUNT, not {agg.fn.upper()}"
+                    )
+                any_distinct = True
+            if isinstance(agg.expr, lx.Wildcard):
+                pexpr = LiteralExpr(1, pa.int64())
+                input_type = pa.int64()
+            else:
+                pexpr = create_physical_expr(agg.expr, in_schema)
+                input_type = agg.expr.data_type(in_schema)
+            fn = agg.fn if not agg.distinct else f"{agg.fn}_distinct"
+            funcs.append(
+                AggregateFunc(fn, pexpr, e.output_name(), e.data_type(in_schema), input_type)
+            )
+
+        single_partition = input.output_partitioning().partition_count() == 1
+        if any_distinct or single_partition:
+            # DISTINCT aggregates need global visibility; single-partition
+            # inputs skip the pointless partial/final split
+            merged = input if single_partition else MergeExec(input)
+            return HashAggregateExec(AggregateMode.SINGLE, merged, group_exprs, funcs)
+
+        partial = HashAggregateExec(AggregateMode.PARTIAL, input, group_exprs, funcs)
+        merged = partial if partial.output_partitioning().partition_count() == 1 else MergeExec(partial)
+        return HashAggregateExec(AggregateMode.FINAL, merged, group_exprs, funcs)
+
+    # ------------------------------------------------------------------
+    def _plan_join(self, plan: lp.Join) -> ExecutionPlan:
+        left = self._plan(plan.left)
+        right = self._plan(plan.right)
+        on: List[Tuple[str, str]] = []
+        for lcol, rcol in plan.on:
+            on.append(
+                (
+                    left.schema().field(lcol.index_in(left.schema())).name,
+                    right.schema().field(rcol.index_in(right.schema())).name,
+                )
+            )
+        if plan.join_type in (lp.JoinType.LEFT, lp.JoinType.FULL):
+            if right.output_partitioning().partition_count() > 1:
+                right = MergeExec(right)
+        join: ExecutionPlan = HashJoinExec(left, right, on, plan.join_type)
+        if plan.filter is not None:
+            join = FilterExec(join, create_physical_expr(plan.filter, join.schema()))
+        return join

@@ -1,0 +1,111 @@
+"""Scan operators: CSV / Parquet / in-memory.
+
+One partition per input file, as the reference's DataFusion scans do
+(CsvExec/ParquetExec, referenced from rust/core/src/serde/physical_plan/from_proto.rs:85-131).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.csv
+import pyarrow.parquet
+
+from ballista_tpu.datasource import CsvTableSource, MemoryTableSource, ParquetTableSource
+from ballista_tpu.physical.plan import ExecutionPlan, Partitioning, TaskContext, batch_table
+
+
+class CsvScanExec(ExecutionPlan):
+    def __init__(self, source: CsvTableSource, projection: Optional[List[int]] = None) -> None:
+        self.source = source
+        self.projection = projection
+        full = source.schema()
+        if projection is None:
+            self._schema = full
+        else:
+            self._schema = pa.schema([full.field(i) for i in projection])
+
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(len(self.source.files))
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        path = self.source.files[partition]
+        full = self.source.schema()
+        read_opts = pa.csv.ReadOptions(
+            column_names=None if self.source.has_header else full.names,
+            block_size=1 << 24,
+        )
+        convert_opts = pa.csv.ConvertOptions(
+            column_types={f.name: f.type for f in full},
+            include_columns=[f.name for f in self._schema] if self.projection is not None else None,
+        )
+        parse_opts = pa.csv.ParseOptions(delimiter=self.source.delimiter)
+        table = pa.csv.read_csv(
+            path, read_options=read_opts, parse_options=parse_opts,
+            convert_options=convert_opts,
+        )
+        table = table.select(self._schema.names).cast(self._schema)
+        yield from batch_table(table, ctx.batch_size)
+
+    def fmt(self) -> str:
+        return f"CsvScanExec: {self.source.path} projection={self.projection}"
+
+
+class ParquetScanExec(ExecutionPlan):
+    def __init__(
+        self, source: ParquetTableSource, projection: Optional[List[int]] = None,
+        batch_size: int = 32768,
+    ) -> None:
+        self.source = source
+        self.projection = projection
+        full = source.schema()
+        if projection is None:
+            self._schema = full
+        else:
+            self._schema = pa.schema([full.field(i) for i in projection])
+
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(len(self.source.files))
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        path = self.source.files[partition]
+        pf = pa.parquet.ParquetFile(path)
+        cols = self._schema.names if self.projection is not None else None
+        for batch in pf.iter_batches(batch_size=ctx.batch_size, columns=cols):
+            yield batch
+
+    def fmt(self) -> str:
+        return f"ParquetScanExec: {self.source.path} projection={self.projection}"
+
+
+class MemoryScanExec(ExecutionPlan):
+    def __init__(self, source: MemoryTableSource, projection: Optional[List[int]] = None) -> None:
+        self.source = source
+        self.projection = projection
+        full = source.schema()
+        if projection is None:
+            self._schema = full
+        else:
+            self._schema = pa.schema([full.field(i) for i in projection])
+
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(self.source.num_partitions())
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        for batch in self.source.partitions[partition]:
+            if self.projection is not None:
+                batch = batch.select(self._schema.names)
+            yield batch
+
+    def fmt(self) -> str:
+        return f"MemoryScanExec: projection={self.projection}"

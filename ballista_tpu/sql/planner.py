@@ -1,0 +1,9 @@
+"""SQL -> LogicalPlan entry point (frontend lands in the next milestone)."""
+
+from __future__ import annotations
+
+from ballista_tpu.errors import SqlError
+
+
+def plan_sql(query: str, ctx) -> "LogicalPlan":  # noqa: F821
+    raise SqlError("SQL frontend not yet available; use the DataFrame API")

@@ -1,0 +1,48 @@
+"""Test harness config.
+
+JAX tests run on a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count), the strategy for validating
+multi-chip sharding without TPU pods. Must run before jax is imported.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture
+def sales_table() -> pa.Table:
+    """Small deterministic table used across operator tests."""
+    return pa.table(
+        {
+            "id": pa.array(list(range(10)), type=pa.int64()),
+            "region": pa.array(
+                ["east", "west", "east", "north", "west",
+                 "east", "north", "west", "east", "west"]
+            ),
+            "amount": pa.array(
+                [10.0, 20.0, 30.0, 5.0, 15.0, 25.0, 35.0, 45.0, 55.0, 65.0]
+            ),
+            "qty": pa.array([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], type=pa.int32()),
+        }
+    )
+
+
+@pytest.fixture
+def ctx():
+    from ballista_tpu.engine import ExecutionContext
+
+    return ExecutionContext()

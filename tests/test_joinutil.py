@@ -1,0 +1,83 @@
+"""join_indices correctness vs brute-force oracle."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.physical.joinutil import combined_key_codes, join_indices
+
+
+def brute_force(left, right, how):
+    pairs = []
+    for i, l in enumerate(left):
+        for j, r in enumerate(right):
+            if l is not None and l == r:
+                pairs.append((i, j))
+    if how == "inner":
+        return set(pairs)
+    if how == "left":
+        matched = {i for i, _ in pairs}
+        return set(pairs) | {(i, -1) for i in range(len(left)) if i not in matched}
+    if how == "right":
+        matched = {j for _, j in pairs}
+        return set(pairs) | {(-1, j) for j in range(len(right)) if j not in matched}
+    if how == "full":
+        ml = {i for i, _ in pairs}
+        mr = {j for _, j in pairs}
+        return (
+            set(pairs)
+            | {(i, -1) for i in range(len(left)) if i not in ml}
+            | {(-1, j) for j in range(len(right)) if j not in mr}
+        )
+    raise ValueError(how)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full"])
+def test_join_vs_brute_force(how):
+    rng = np.random.default_rng(42)
+    left = rng.integers(0, 20, size=50).tolist()
+    right = rng.integers(0, 20, size=30).tolist()
+    lc, rc = combined_key_codes([pa.array(left)], [pa.array(right)])
+    li, ri = join_indices(lc, rc, how)
+    got = set(zip(li.tolist(), ri.tolist()))
+    assert got == brute_force(left, right, how)
+
+
+def test_join_with_nulls_never_match():
+    left = pa.array([1, None, 2])
+    right = pa.array([None, 1, 3])
+    lc, rc = combined_key_codes([left], [right])
+    li, ri = join_indices(lc, rc, "inner")
+    assert list(zip(li.tolist(), ri.tolist())) == [(0, 1)]
+
+
+def test_semi_anti():
+    left = pa.array([1, 2, 3, 4])
+    right = pa.array([2, 4, 4])
+    lc, rc = combined_key_codes([left], [right])
+    semi, _ = join_indices(lc, rc, "semi")
+    assert semi.tolist() == [1, 3]
+    anti, _ = join_indices(lc, rc, "anti")
+    assert anti.tolist() == [0, 2]
+    # right-side (probe) variants: build=left, probe=right
+    semi_r, _ = join_indices(lc, rc, "semi_right")
+    assert semi_r.tolist() == [0, 1, 2]
+    anti_r, _ = join_indices(lc, rc, "anti_right")
+    assert anti_r.tolist() == []
+
+
+def test_composite_string_keys():
+    lk = [pa.array(["a", "b", "a"]), pa.array([1, 1, 2])]
+    rk = [pa.array(["a", "a", "c"]), pa.array([2, 9, 1])]
+    lc, rc = combined_key_codes(lk, rk)
+    li, ri = join_indices(lc, rc, "inner")
+    assert list(zip(li.tolist(), ri.tolist())) == [(2, 0)]
+
+
+def test_duplicate_build_keys_expand():
+    left = pa.array([7, 7, 8])
+    right = pa.array([7])
+    lc, rc = combined_key_codes([left], [right])
+    li, ri = join_indices(lc, rc, "inner")
+    assert sorted(li.tolist()) == [0, 1]
+    assert ri.tolist() == [0, 0]

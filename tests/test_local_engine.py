@@ -1,0 +1,162 @@
+"""End-to-end single-process engine tests (host/Arrow backend oracle)."""
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.logical import col, functions as F, lit
+
+
+def _register(ctx, sales_table, n_partitions=1):
+    ctx.register_record_batches("sales", sales_table, n_partitions=n_partitions)
+
+
+def test_filter_project(ctx, sales_table):
+    _register(ctx, sales_table)
+    df = (
+        ctx.table("sales")
+        .filter(col("amount") > lit(20.0))
+        .select(col("id"), (col("amount") * lit(2.0)).alias("double_amount"))
+    )
+    out = df.collect()
+    assert out.column_names == ["id", "double_amount"]
+    assert out.num_rows == 6
+    assert out.column("double_amount").to_pylist() == [60.0, 50.0, 70.0, 90.0, 110.0, 130.0]
+
+
+@pytest.mark.parametrize("n_partitions", [1, 3])
+def test_aggregate_partial_final(ctx, sales_table, n_partitions):
+    _register(ctx, sales_table, n_partitions)
+    df = ctx.table("sales").aggregate(
+        [col("region")],
+        [
+            F.sum(col("amount")).alias("total"),
+            F.avg(col("amount")).alias("avg_amount"),
+            F.count(col("id")).alias("n"),
+            F.min(col("qty")).alias("min_qty"),
+            F.max(col("qty")).alias("max_qty"),
+        ],
+    ).sort(col("region").sort())
+    out = df.collect()
+    assert out.column("region").to_pylist() == ["east", "north", "west"]
+    assert out.column("total").to_pylist() == [120.0, 40.0, 145.0]
+    assert out.column("n").to_pylist() == [4, 2, 4]
+    assert out.column("min_qty").to_pylist() == [1, 4, 2]
+    assert out.column("max_qty").to_pylist() == [9, 7, 10]
+    avg = out.column("avg_amount").to_pylist()
+    assert avg[0] == pytest.approx(30.0)
+
+
+def test_scalar_aggregate_no_groups(ctx, sales_table):
+    _register(ctx, sales_table, 2)
+    out = ctx.table("sales").aggregate(
+        [], [F.sum(col("amount")).alias("s"), F.count(col("id")).alias("c")]
+    ).collect()
+    assert out.num_rows == 1
+    assert out.column("s").to_pylist() == [305.0]
+    assert out.column("c").to_pylist() == [10]
+
+
+def test_sort_limit(ctx, sales_table):
+    _register(ctx, sales_table, 2)
+    out = (
+        ctx.table("sales")
+        .select(col("id"), col("amount"))
+        .sort(col("amount").sort(ascending=False))
+        .limit(3)
+        .collect()
+    )
+    assert out.column("amount").to_pylist() == [65.0, 55.0, 45.0]
+
+
+def test_join(ctx, sales_table):
+    _register(ctx, sales_table)
+    regions = pa.table(
+        {
+            "name": pa.array(["east", "west", "north", "south"]),
+            "manager": pa.array(["alice", "bob", "carol", "dan"]),
+        }
+    )
+    ctx.register_record_batches("regions", regions)
+    out = (
+        ctx.table("sales")
+        .join(ctx.table("regions"), ["region"], ["name"])
+        .select(col("id"), col("manager"))
+        .sort(col("id").sort())
+        .collect()
+    )
+    assert out.num_rows == 10
+    assert out.column("manager").to_pylist()[:4] == ["alice", "bob", "alice", "carol"]
+
+
+def test_join_left_outer(ctx):
+    left = pa.table({"k": [1, 2, 3], "v": ["a", "b", "c"]})
+    right = pa.table({"k2": [2, 3, 4], "w": [20, 30, 40]})
+    from ballista_tpu.engine import ExecutionContext
+
+    c = ExecutionContext()
+    c.register_record_batches("l", left)
+    c.register_record_batches("r", right)
+    out = (
+        c.table("l")
+        .join(c.table("r"), ["k"], ["k2"], how="left")
+        .sort(col("k").sort())
+        .collect()
+    )
+    assert out.num_rows == 3
+    assert out.column("w").to_pylist() == [None, 20, 30]
+
+
+def test_repartition_roundtrip(ctx, sales_table):
+    _register(ctx, sales_table)
+    out = (
+        ctx.table("sales")
+        .repartition(4, col("region"))
+        .aggregate([col("region")], [F.sum(col("amount")).alias("t")])
+        .sort(col("region").sort())
+        .collect()
+    )
+    assert out.column("t").to_pylist() == [120.0, 40.0, 145.0]
+
+
+def test_distinct(ctx, sales_table):
+    _register(ctx, sales_table, 2)
+    out = ctx.table("sales").select(col("region")).distinct().sort(col("region").sort()).collect()
+    assert out.column("region").to_pylist() == ["east", "north", "west"]
+
+
+def test_union(ctx, sales_table):
+    _register(ctx, sales_table)
+    a = ctx.table("sales").select(col("id")).filter(col("id") < lit(3))
+    b = ctx.table("sales").select(col("id")).filter(col("id") >= lit(8))
+    out = a.union(b).sort(col("id").sort()).collect()
+    assert out.column("id").to_pylist() == [0, 1, 2, 8, 9]
+
+
+def test_case_expr(ctx, sales_table):
+    _register(ctx, sales_table)
+    from ballista_tpu.logical.expr import Case
+
+    e = Case(
+        None,
+        [(col("amount") > lit(30.0), lit("big"))],
+        lit("small"),
+    ).alias("size")
+    out = ctx.table("sales").select(col("id"), e).sort(col("id").sort()).collect()
+    assert out.column("size").to_pylist()[:4] == ["small", "small", "small", "small"]
+    assert out.column("size").to_pylist()[7] == "big"
+
+
+def test_projection_pushdown_narrows_scan(ctx, sales_table):
+    _register(ctx, sales_table)
+    df = ctx.table("sales").select(col("id"))
+    plan = ctx.optimize(df.logical_plan())
+    scan = plan
+    while scan.children():
+        scan = scan.children()[0]
+    assert scan.projection == [0]
+
+
+def test_explain(ctx, sales_table):
+    _register(ctx, sales_table)
+    text = ctx.table("sales").select(col("id")).explain()
+    assert "Logical Plan" in text and "ProjectionExec" in text

@@ -1,0 +1,78 @@
+"""Logical expression and plan tests."""
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.logical import (
+    Aggregate,
+    Column,
+    Filter,
+    Projection,
+    TableScan,
+    col,
+    functions as F,
+    lit,
+)
+from ballista_tpu.datasource import MemoryTableSource
+from ballista_tpu.errors import SchemaError
+
+
+SCHEMA = pa.schema(
+    [
+        pa.field("a", pa.int64()),
+        pa.field("b", pa.float64()),
+        pa.field("c", pa.string()),
+    ]
+)
+
+
+def _scan():
+    src = MemoryTableSource(SCHEMA, [[]])
+    return TableScan("t", src)
+
+
+def test_column_type_resolution():
+    assert col("a").data_type(SCHEMA) == pa.int64()
+    assert col("b").data_type(SCHEMA) == pa.float64()
+    with pytest.raises(SchemaError):
+        col("nope").data_type(SCHEMA)
+
+
+def test_binary_expr_types():
+    e = col("a") + col("b")
+    assert e.data_type(SCHEMA) == pa.float64()
+    cmp = col("a") > lit(5)
+    assert cmp.data_type(SCHEMA) == pa.bool_()
+    assert str(cmp) == "(#a > 5)"
+
+
+def test_aggregate_types():
+    assert F.sum(col("a")).data_type(SCHEMA) == pa.int64()
+    assert F.sum(col("b")).data_type(SCHEMA) == pa.float64()
+    assert F.avg(col("a")).data_type(SCHEMA) == pa.float64()
+    assert F.count(col("c")).data_type(SCHEMA) == pa.int64()
+
+
+def test_alias_output_name():
+    e = (col("a") * lit(2)).alias("doubled")
+    assert e.output_name() == "doubled"
+    assert e.data_type(SCHEMA) == pa.int64()
+
+
+def test_plan_schemas():
+    scan = _scan()
+    proj = Projection(scan, [col("a"), (col("b") * lit(2.0)).alias("b2")])
+    assert proj.schema().names == ["a", "b2"]
+    filt = Filter(proj, col("a") > lit(1))
+    assert filt.schema().names == ["a", "b2"]
+    agg = Aggregate(scan, [col("c")], [F.sum(col("a")).alias("total")])
+    assert agg.schema().names == ["c", "total"]
+    assert agg.schema().field("total").type == pa.int64()
+
+
+def test_qualified_column_resolution():
+    schema = pa.schema([pa.field("t.a", pa.int64()), pa.field("u.a", pa.int32())])
+    assert Column("a", "t").data_type(schema) == pa.int64()
+    assert Column("a", "u").data_type(schema) == pa.int32()
+    with pytest.raises(SchemaError):
+        Column("a").data_type(schema)  # ambiguous

@@ -1,0 +1,113 @@
+"""Regression tests for code-review findings on the engine core."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.errors import PlanError
+from ballista_tpu.logical import col, functions as F
+from ballista_tpu.logical.expr import AggregateExpr
+from ballista_tpu.physical.joinutil import combined_key_codes, join_indices
+
+
+def test_wide_int64_keys_no_overflow():
+    # 64-bit id-style keys spanning > 2^32: packing must not wrap
+    k = 2**32
+    left = pa.array([k, 2 * k, 3 * k], type=pa.int64())
+    right = pa.array([2 * k, 5], type=pa.int64())
+    lc, rc = combined_key_codes([left], [right])
+    li, ri = join_indices(lc, rc, "inner")
+    assert list(zip(li.tolist(), ri.tolist())) == [(1, 0)]
+
+
+def test_composite_wide_keys_no_overflow():
+    k = 2**31
+    left = [pa.array([k, 2 * k], type=pa.int64()), pa.array([3 * k, 4 * k], type=pa.int64())]
+    right = [pa.array([2 * k, k], type=pa.int64()), pa.array([4 * k, 9], type=pa.int64())]
+    lc, rc = combined_key_codes(left, right)
+    li, ri = join_indices(lc, rc, "inner")
+    assert list(zip(li.tolist(), ri.tolist())) == [(1, 0)]
+
+
+def test_cross_join_duplicate_names_rejected():
+    ctx = ExecutionContext()
+    ctx.register_record_batches("l", pa.table({"k": [1, 2]}))
+    ctx.register_record_batches("r", pa.table({"k": [3]}))
+    from ballista_tpu.logical.plan import CrossJoin
+
+    with pytest.raises(PlanError, match="duplicate field"):
+        CrossJoin(
+            ctx.table("l").logical_plan(), ctx.table("r").logical_plan()
+        )
+
+
+def test_cross_join_with_aliases():
+    ctx = ExecutionContext()
+    ctx.register_record_batches("l", pa.table({"k": [1, 2]}))
+    ctx.register_record_batches("r", pa.table({"k": [3]}))
+    from ballista_tpu.logical.plan import CrossJoin
+    from ballista_tpu.logical.builder import LogicalPlanBuilder
+
+    plan = CrossJoin(
+        ctx.table("l").alias("a").logical_plan(),
+        ctx.table("r").alias("b").logical_plan(),
+    )
+    out = ctx.collect(plan)
+    assert out.column_names == ["a.k", "b.k"]
+    assert sorted(out.column("a.k").to_pylist()) == [1, 2]
+    assert out.column("b.k").to_pylist() == [3, 3]
+
+
+def test_distinct_over_alias():
+    ctx = ExecutionContext()
+    ctx.register_record_batches("t", pa.table({"a": [1, 1, 2]}))
+    out = ctx.table("t").alias("x").distinct().collect()
+    assert out.column_names == ["x.a"]
+    assert sorted(out.column("x.a").to_pylist()) == [1, 2]
+
+
+def test_sum_distinct_rejected_at_plan_time():
+    ctx = ExecutionContext()
+    ctx.register_record_batches("t", pa.table({"a": [1, 1, 2], "g": [1, 1, 2]}))
+    df = ctx.table("t").aggregate(
+        [col("g")], [AggregateExpr("sum", col("a"), distinct=True).alias("s")]
+    )
+    with pytest.raises(PlanError, match="DISTINCT is only supported for COUNT"):
+        df.collect()
+
+
+def test_count_distinct():
+    ctx = ExecutionContext()
+    ctx.register_record_batches(
+        "t", pa.table({"g": [1, 1, 1, 2], "a": [5, 5, 6, 7]}), n_partitions=2
+    )
+    out = (
+        ctx.table("t")
+        .aggregate([col("g")], [F.count(col("a"), distinct=True).alias("c")])
+        .sort(col("g").sort())
+        .collect()
+    )
+    assert out.column("c").to_pylist() == [2, 1]
+
+
+def test_single_partition_uses_single_mode():
+    ctx = ExecutionContext()
+    ctx.register_record_batches("t", pa.table({"g": [1, 2], "a": [3, 4]}))
+    df = ctx.table("t").aggregate([col("g")], [F.sum(col("a")).alias("s")])
+    physical = ctx.create_physical_plan(df.logical_plan())
+    from ballista_tpu.physical.aggregate import AggregateMode, HashAggregateExec
+
+    assert isinstance(physical, HashAggregateExec)
+    assert physical.mode == AggregateMode.SINGLE
+
+
+def test_tpu_backend_falls_back_cleanly():
+    from ballista_tpu.config import BallistaConfig
+
+    ctx = ExecutionContext(BallistaConfig({"ballista.executor.backend": "tpu"}))
+    ctx.register_record_batches("t", pa.table({"a": [1, 2, 3]}))
+    from ballista_tpu.logical import lit
+
+    out = ctx.table("t").filter(col("a") > lit(1)).select(col("a")).collect()
+    assert out.column("a").to_pylist() == [2, 3]
