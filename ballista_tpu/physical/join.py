@@ -37,11 +37,15 @@ class HashJoinExec(ExecutionPlan):
         right: ExecutionPlan,
         on: List[Tuple[str, str]],  # (left column name, right column name)
         join_type: JoinType,
+        filter=None,  # residual PhysicalExpr over concat(left, right) schema
     ) -> None:
         self.left = left
         self.right = right
         self.on = on
         self.join_type = join_type
+        self.filter = filter
+        if filter is not None and join_type not in (JoinType.SEMI, JoinType.ANTI):
+            raise PlanError("join residual filter only supported for SEMI/ANTI")
         if join_type in (JoinType.SEMI, JoinType.ANTI):
             self._schema = left.schema()
         else:
@@ -61,7 +65,9 @@ class HashJoinExec(ExecutionPlan):
         return [self.left, self.right]
 
     def with_children(self, children: List[ExecutionPlan]) -> "HashJoinExec":
-        return HashJoinExec(children[0], children[1], self.on, self.join_type)
+        return HashJoinExec(
+            children[0], children[1], self.on, self.join_type, filter=self.filter
+        )
 
     def _collect_build(self, side: ExecutionPlan, ctx: TaskContext) -> pa.Table:
         with self._build_lock:
@@ -81,8 +87,11 @@ class HashJoinExec(ExecutionPlan):
                 [build.column(k) for k in right_keys],
                 [probe.column(k) for k in left_keys],
             )
-            how = "semi_right" if self.join_type == JoinType.SEMI else "anti_right"
-            keep_idx, _ = join_indices(bcodes, pcodes, how)
+            if self.filter is None:
+                how = "semi_right" if self.join_type == JoinType.SEMI else "anti_right"
+                keep_idx, _ = join_indices(bcodes, pcodes, how)
+            else:
+                keep_idx = self._filtered_semi_indices(build, probe, bcodes, pcodes)
             out = probe.take(pa.array(keep_idx))
             yield from batch_table(out, ctx.batch_size)
             return
@@ -111,9 +120,44 @@ class HashJoinExec(ExecutionPlan):
         out = pa.table(cols, schema=self._schema)
         yield from batch_table(out, ctx.batch_size)
 
+    def _filtered_semi_indices(
+        self,
+        build: pa.Table,
+        probe: pa.Table,
+        bcodes: np.ndarray,
+        pcodes: np.ndarray,
+    ) -> np.ndarray:
+        """SEMI/ANTI with a residual predicate: expand the inner join on the
+        equi keys, evaluate the filter over concat(probe-cols, build-cols),
+        keep probe rows with >=1 surviving match (SEMI) or none (ANTI)."""
+        import pyarrow.compute as pc
+
+        build_idx, probe_idx = join_indices(bcodes, pcodes, "inner")
+        matched = np.zeros(probe.num_rows, dtype=bool)
+        if len(probe_idx):
+            probe_rows = probe.take(pa.array(probe_idx))
+            build_rows = build.take(pa.array(build_idx))
+            combined_schema = pa.schema(list(probe.schema) + list(build.schema))
+            combined = pa.table(
+                list(probe_rows.columns) + list(build_rows.columns),
+                schema=combined_schema,
+            ).combine_chunks()
+            batches = combined.to_batches()
+            offset = 0
+            for b in batches:
+                mask = self.filter.evaluate(b)
+                mask_np = pc.fill_null(mask, False).to_numpy(zero_copy_only=False)
+                hits = probe_idx[offset: offset + b.num_rows][mask_np.astype(bool)]
+                matched[hits] = True
+                offset += b.num_rows
+        if self.join_type == JoinType.SEMI:
+            return np.nonzero(matched)[0]
+        return np.nonzero(~matched)[0]
+
     def fmt(self) -> str:
         on = ", ".join(f"{l} = {r}" for l, r in self.on)
-        return f"HashJoinExec: type={self.join_type.value}, on=[{on}]"
+        extra = f", filter={self.filter}" if self.filter is not None else ""
+        return f"HashJoinExec: type={self.join_type.value}, on=[{on}]{extra}"
 
 
 class CrossJoinExec(ExecutionPlan):

@@ -199,6 +199,16 @@ class PhysicalPlanner:
         if plan.join_type in (lp.JoinType.LEFT, lp.JoinType.FULL):
             if right.output_partitioning().partition_count() > 1:
                 right = MergeExec(right)
+        if plan.join_type in (lp.JoinType.SEMI, lp.JoinType.ANTI):
+            # residual predicates evaluate over concat(left, right) during
+            # the join itself (the right side is absent from the output)
+            pfilter = None
+            if plan.filter is not None:
+                concat_schema = pa.schema(
+                    list(left.schema()) + list(right.schema())
+                )
+                pfilter = create_physical_expr(plan.filter, concat_schema)
+            return HashJoinExec(left, right, on, plan.join_type, filter=pfilter)
         join: ExecutionPlan = HashJoinExec(left, right, on, plan.join_type)
         if plan.filter is not None:
             join = FilterExec(join, create_physical_expr(plan.filter, join.schema()))

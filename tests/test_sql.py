@@ -1,0 +1,140 @@
+"""SQL frontend unit tests: parser, planner, DDL, edge cases."""
+
+import datetime
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.errors import SqlError
+from ballista_tpu.sql.parser import parse_sql, _add_interval
+
+
+@pytest.fixture
+def ctx(sales_table):
+    c = ExecutionContext()
+    c.register_record_batches("sales", sales_table, n_partitions=2)
+    return c
+
+
+def test_basic_select(ctx):
+    out = ctx.sql("select id, amount * 2 as a2 from sales where amount > 20 order by id").collect()
+    assert out.column_names == ["id", "a2"]
+    assert out.column("a2").to_pylist() == [60.0, 50.0, 70.0, 90.0, 110.0, 130.0]
+
+
+def test_group_having_order(ctx):
+    out = ctx.sql(
+        """
+        select region, sum(amount) as total, count(*) as n
+        from sales group by region having sum(amount) > 50
+        order by total desc
+        """
+    ).collect()
+    assert out.column("region").to_pylist() == ["west", "east"]
+    assert out.column("total").to_pylist() == [145.0, 120.0]
+
+
+def test_order_by_ordinal_and_limit(ctx):
+    out = ctx.sql("select id, amount from sales order by 2 desc limit 3").collect()
+    assert out.column("amount").to_pylist() == [65.0, 55.0, 45.0]
+
+
+def test_case_when(ctx):
+    out = ctx.sql(
+        "select id, case when amount > 30 then 'big' else 'small' end as sz "
+        "from sales order by id limit 4"
+    ).collect()
+    assert out.column("sz").to_pylist() == ["small", "small", "small", "small"]
+
+
+def test_distinct_union(ctx):
+    out = ctx.sql(
+        "select region from sales where id < 3 "
+        "union select region from sales where id >= 8 order by region"
+    ).collect()
+    assert out.column("region").to_pylist() == ["east", "west"]
+
+
+def test_in_list_between_like(ctx):
+    out = ctx.sql(
+        "select id from sales where region in ('east', 'north') "
+        "and amount between 5 and 35 and region like '%t%' order by id"
+    ).collect()
+    assert out.column("id").to_pylist() == [0, 2, 3, 5, 6]
+
+
+def test_interval_folding():
+    d = datetime.date(1994, 1, 1)
+    assert _add_interval(d, 12, 0) == datetime.date(1995, 1, 1)
+    assert _add_interval(d, 3, 0) == datetime.date(1994, 4, 1)
+    assert _add_interval(datetime.date(1994, 1, 31), 1, 0) == datetime.date(1994, 2, 28)
+    assert _add_interval(d, 0, 90) == datetime.date(1994, 4, 1)
+
+
+def test_parse_errors():
+    with pytest.raises(SqlError):
+        parse_sql("select from")
+    with pytest.raises(SqlError):
+        parse_sql("select 1 limit 'x'")
+    with pytest.raises(SqlError):
+        parse_sql("select 'unterminated")
+    with pytest.raises(SqlError):
+        parse_sql("select 1 ; garbage")
+
+
+def test_create_external_table(tmp_path, sales_table):
+    import pyarrow.csv as pcsv
+
+    p = tmp_path / "sales.csv"
+    pcsv.write_csv(sales_table, p)
+    ctx = ExecutionContext()
+    ctx.sql(
+        f"create external table sales stored as csv with header row location '{p}'"
+    )
+    out = ctx.sql("select count(*) as n from sales").collect()
+    assert out.column("n").to_pylist() == [10]
+
+
+def test_explain(ctx):
+    df = ctx.sql("explain select id from sales")
+    plan = df.logical_plan()
+    from ballista_tpu.logical.plan import Explain
+
+    assert isinstance(plan, Explain)
+
+
+def test_table_alias_and_self_join(ctx):
+    out = ctx.sql(
+        """
+        select a.id, b.id as other
+        from sales a, sales b
+        where a.id = b.id and a.id < 2
+        order by a.id
+        """
+    ).collect()
+    assert out.column("id").to_pylist() == [0, 1]
+    assert out.column("other").to_pylist() == [0, 1]
+
+
+def test_derived_table(ctx):
+    out = ctx.sql(
+        """
+        select r, t from (
+            select region as r, sum(amount) as t from sales group by region
+        ) as sub where t > 50 order by t
+        """
+    ).collect()
+    assert out.column("r").to_pylist() == ["east", "west"]
+
+
+def test_scalar_subquery_uncorrelated(ctx):
+    out = ctx.sql(
+        "select id from sales where amount > (select avg(amount) from sales) order by id"
+    ).collect()
+    assert out.column("id").to_pylist() == [6, 7, 8, 9]
+
+
+def test_count_star_empty_group(ctx):
+    out = ctx.sql("select count(*) as n from sales where amount > 1000").collect()
+    assert out.column("n").to_pylist() == [0]

@@ -1,0 +1,65 @@
+"""Regression tests for subquery decorrelation semantics (code-review findings)."""
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.errors import SqlError
+
+
+@pytest.fixture
+def ctx():
+    c = ExecutionContext()
+    c.register_record_batches("t", pa.table({"a": [1, 2, 3], "b": [10, 20, 30]}))
+    c.register_record_batches("s", pa.table({"x": [1, 2], "y": [1, 1]}))
+    c.register_record_batches("s_null", pa.table({"x": [1, None]}))
+    return c
+
+
+def test_correlated_count_empty_group_is_zero(ctx):
+    # a=3 has no matching s rows; COUNT over the empty group is 0, so the
+    # predicate 0 = count(...) must KEEP that row
+    out = ctx.sql(
+        "select a from t where 0 = (select count(*) from s where s.x = t.a) order by a"
+    ).collect()
+    assert out.column("a").to_pylist() == [3]
+
+
+def test_correlated_sum_empty_group_is_null(ctx):
+    # SUM over the empty group is NULL; comparison with NULL is unknown -> drop
+    out = ctx.sql(
+        "select a from t where 1 <= (select sum(y) from s where s.x = t.a) order by a"
+    ).collect()
+    assert out.column("a").to_pylist() == [1, 2]
+
+
+def test_not_in_with_null_in_subquery_returns_nothing(ctx):
+    out = ctx.sql("select a from t where a not in (select x from s_null)").collect()
+    assert out.num_rows == 0
+
+
+def test_not_in_without_nulls(ctx):
+    out = ctx.sql(
+        "select a from t where a not in (select x from s) order by a"
+    ).collect()
+    assert out.column("a").to_pylist() == [3]
+
+
+def test_not_in_select_star_stays_clean(ctx):
+    # the null-guard helper column must not leak into SELECT *
+    out = ctx.sql("select * from t where a not in (select x from s)").collect()
+    assert out.column_names == ["a", "b"]
+
+
+def test_correlated_in_subquery(ctx):
+    out = ctx.sql(
+        "select a from t where a in (select y from s where s.x = t.a) order by a"
+    ).collect()
+    # s rows: (x=1,y=1), (x=2,y=1); for t.a=1 the group is {y=1} -> 1 in it;
+    # for t.a=2 the group is {y=1} -> 2 not in it
+    assert out.column("a").to_pylist() == [1]
+
+
+def test_union_mismatched_columns_rejected(ctx):
+    with pytest.raises(SqlError, match="column counts"):
+        ctx.sql("select a from t union all select x, y from s")
