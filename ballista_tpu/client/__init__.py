@@ -1,0 +1,1 @@
+from ballista_tpu.client.context import BallistaContext, BallistaDataFrame  # noqa: F401

@@ -20,6 +20,8 @@ BALLISTA_BACKEND = "ballista.executor.backend"  # "cpu" (Arrow host kernels) | "
 BALLISTA_STAGE_FUSION = "ballista.tpu.stage_fusion"  # whole-stage SPMD compilation on/off
 BALLISTA_MESH_SHAPE = "ballista.tpu.mesh"  # e.g. "data:8" or "data:4,model:2"
 BALLISTA_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
+BALLISTA_DEVICE_CACHE = "ballista.tpu.device_cache"  # keep encoded columns resident in HBM
+BALLISTA_SCAN_CACHE = "ballista.scan.cache"  # host-side decoded-table cache (parquet)
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -29,6 +31,8 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_STAGE_FUSION: "true",
     BALLISTA_MESH_SHAPE: "data:1",
     BALLISTA_SHUFFLE_PARTITIONS: "16",
+    BALLISTA_DEVICE_CACHE: "true",
+    BALLISTA_SCAN_CACHE: "true",
 }
 
 
@@ -63,6 +67,12 @@ class BallistaConfig(Mapping[str, str]):
 
     def shuffle_partitions(self) -> int:
         return int(self._settings[BALLISTA_SHUFFLE_PARTITIONS])
+
+    def device_cache(self) -> bool:
+        return self._settings[BALLISTA_DEVICE_CACHE].lower() in ("1", "true", "yes")
+
+    def scan_cache(self) -> bool:
+        return self._settings[BALLISTA_SCAN_CACHE].lower() in ("1", "true", "yes")
 
     def mesh_shape(self) -> Dict[str, int]:
         """Parse "data:4,model:2" into {"data": 4, "model": 2}."""

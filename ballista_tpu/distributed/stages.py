@@ -1,0 +1,262 @@
+"""Distributed execution operators.
+
+The reference's stage-stitching operator trio
+(rust/core/src/execution_plans/): QueryStageExec -> here ShuffleWriterExec
+(with map-side hash split, the design later Ballista versions adopted),
+ShuffleReaderExec (fetch materialized partitions from peers), and
+UnresolvedShuffleExec (placeholder until upstream stages complete,
+ref unresolved_shuffle.rs:34-91).
+
+Shuffle file layout under an executor's work dir:
+    {work_dir}/{job_id}/{stage_id}/{input_partition}/{output_partition}.arrow
+CompletedTask.path points at the {input_partition} directory; readers derive
+piece paths from it (ref flight_service.rs:104-126 wrote a single data.arrow).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.ipc
+
+from ballista_tpu.errors import ExecutionError, InternalError
+from ballista_tpu.physical.expr import PhysicalExpr
+from ballista_tpu.physical.plan import (
+    ExecutionPlan,
+    Partitioning,
+    TaskContext,
+    batch_table,
+)
+from ballista_tpu.physical.repartition import hash_rows
+from ballista_tpu.physical.expr import _as_array
+
+
+class PartitionStats:
+    """Row/batch/byte counts for a materialized partition
+    (ref utils.rs:49-84 PartitionStats accumulation)."""
+
+    def __init__(self, num_rows: int = 0, num_batches: int = 0, num_bytes: int = 0) -> None:
+        self.num_rows = num_rows
+        self.num_batches = num_batches
+        self.num_bytes = num_bytes
+
+    def __repr__(self) -> str:
+        return f"PartitionStats(rows={self.num_rows}, batches={self.num_batches}, bytes={self.num_bytes})"
+
+
+def write_stream_to_disk(
+    batches: Iterator[pa.RecordBatch], schema: pa.Schema, path: str
+) -> PartitionStats:
+    """Arrow IPC file writer with stats (ref utils.rs write_stream_to_disk)."""
+    stats = PartitionStats()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with pa.ipc.new_file(path, schema) as w:
+        for b in batches:
+            w.write_batch(b)
+            stats.num_rows += b.num_rows
+            stats.num_batches += 1
+            stats.num_bytes += b.nbytes
+    return stats
+
+
+def read_ipc_file(path: str) -> Iterator[pa.RecordBatch]:
+    with pa.ipc.open_file(path) as r:
+        for i in range(r.num_record_batches):
+            yield r.get_batch(i)
+
+
+class ShuffleWriterExec(ExecutionPlan):
+    """Stage-top operator: executes one input partition of its child and
+    materializes it, hash/round-robin split across output partitions."""
+
+    def __init__(
+        self,
+        job_id: str,
+        stage_id: int,
+        input: ExecutionPlan,
+        output_partitioning: Optional[Partitioning] = None,
+    ) -> None:
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.input = input
+        # None -> passthrough (one output piece per input partition)
+        self.shuffle_output_partitioning = output_partitioning
+
+    def schema(self) -> pa.Schema:
+        return self.input.schema()
+
+    def output_partitioning(self) -> Partitioning:
+        # tasks are per INPUT partition
+        return self.input.output_partitioning()
+
+    def children(self) -> List[ExecutionPlan]:
+        return [self.input]
+
+    def with_children(self, children: List[ExecutionPlan]) -> "ShuffleWriterExec":
+        return ShuffleWriterExec(
+            self.job_id, self.stage_id, children[0], self.shuffle_output_partitioning
+        )
+
+    def out_partition_count(self) -> int:
+        if self.shuffle_output_partitioning is None:
+            return self.input.output_partitioning().partition_count()
+        return self.shuffle_output_partitioning.partition_count()
+
+    # ------------------------------------------------------------------
+    def execute_shuffle_write(self, partition: int, ctx: TaskContext) -> PartitionStats:
+        """Run the child partition and write the split pieces; returns
+        aggregate stats. Piece paths: {work_dir}/{job}/{stage}/{partition}/{m}.arrow"""
+        if ctx.work_dir is None:
+            raise ExecutionError("shuffle write requires a work_dir")
+        base = os.path.join(
+            ctx.work_dir, self.job_id, str(self.stage_id), str(partition)
+        )
+        schema = self.schema()
+        pscheme = self.shuffle_output_partitioning
+        total = PartitionStats()
+        if pscheme is None:
+            stats = write_stream_to_disk(
+                self.input.execute(partition, ctx), schema,
+                os.path.join(base, "0.arrow"),
+            )
+            return stats
+        n_out = pscheme.partition_count()
+        writers = []
+        os.makedirs(base, exist_ok=True)
+        for m in range(n_out):
+            sink = pa.OSFile(os.path.join(base, f"{m}.arrow"), "wb")
+            writers.append((sink, pa.ipc.new_file(sink, schema)))
+        try:
+            for batch in self.input.execute(partition, ctx):
+                if pscheme.scheme == "hash":
+                    keys = [
+                        _as_array(e.evaluate(batch), batch.num_rows)
+                        for e in pscheme.exprs
+                    ]
+                    ids = hash_rows(keys, n_out)
+                else:
+                    import numpy as np
+
+                    ids = np.arange(batch.num_rows, dtype=np.int64) % n_out
+                import numpy as np
+
+                for m in range(n_out):
+                    piece = batch.filter(pa.array(ids == m))
+                    if piece.num_rows:
+                        writers[m][1].write_batch(piece)
+                        total.num_rows += piece.num_rows
+                        total.num_bytes += piece.nbytes
+                total.num_batches += 1
+        finally:
+            for sink, w in writers:
+                w.close()
+                sink.close()
+        return total
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        # in-process fallback: write then read back the pieces concatenated
+        self.execute_shuffle_write(partition, ctx)
+        base = os.path.join(
+            ctx.work_dir, self.job_id, str(self.stage_id), str(partition)
+        )
+        for name in sorted(os.listdir(base)):
+            yield from read_ipc_file(os.path.join(base, name))
+
+    def fmt(self) -> str:
+        return (
+            f"ShuffleWriterExec: job={self.job_id}, stage={self.stage_id}, "
+            f"out={self.shuffle_output_partitioning!r}"
+        )
+
+
+class ShuffleLocation:
+    """Where one completed map task's output lives."""
+
+    def __init__(self, executor_id: str, host: str, port: int, path: str) -> None:
+        self.executor_id = executor_id
+        self.host = host
+        self.port = port
+        self.path = path  # base dir containing {m}.arrow pieces
+
+    def __repr__(self) -> str:
+        return f"ShuffleLocation({self.executor_id}@{self.host}:{self.port}, {self.path})"
+
+
+class ShuffleReaderExec(ExecutionPlan):
+    """Leaf reading previously materialized shuffle output
+    (ref shuffle_reader.rs:33-100). For output partition m it fetches piece m
+    from every map task's location — local disk read or Flight fetch via
+    ctx.shuffle_fetcher."""
+
+    def __init__(
+        self,
+        locations: List[ShuffleLocation],
+        schema: pa.Schema,
+        num_partitions: int,
+        identity: bool = False,
+    ) -> None:
+        self.locations = locations
+        self._schema = schema
+        self.num_partitions = num_partitions
+        # identity mapping: output partition m is exactly map task m's single
+        # piece (a passthrough/merge boundary, no re-split)
+        self.identity = identity
+
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(self.num_partitions)
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        if self.identity:
+            loc = self.locations[partition]
+            yield from self._read_piece(loc, 0, ctx)
+            return
+        for loc in self.locations:
+            yield from self._read_piece(loc, partition, ctx)
+
+    def _read_piece(
+        self, loc: ShuffleLocation, piece_idx: int, ctx: TaskContext
+    ) -> Iterator[pa.RecordBatch]:
+        piece = os.path.join(loc.path, f"{piece_idx}.arrow")
+        if os.path.exists(piece):
+            yield from read_ipc_file(piece)
+        elif ctx.shuffle_fetcher is not None:
+            yield from ctx.shuffle_fetcher(loc, piece_idx)
+        else:
+            raise ExecutionError(
+                f"shuffle piece not found locally and no fetcher: {piece}"
+            )
+
+    def fmt(self) -> str:
+        return f"ShuffleReaderExec: partitions={self.num_partitions}, maps={len(self.locations)}"
+
+
+class UnresolvedShuffleExec(ExecutionPlan):
+    """Placeholder for a dependency stage whose outputs don't exist yet
+    (ref unresolved_shuffle.rs). Refuses to execute."""
+
+    def __init__(self, stage_id: int, schema: pa.Schema, partition_count: int,
+                 identity: bool = False) -> None:
+        self.stage_id = stage_id
+        self._schema = schema
+        self.partition_count = partition_count
+        self.identity = identity
+
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(self.partition_count)
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        raise InternalError(
+            f"UnresolvedShuffleExec(stage={self.stage_id}) cannot execute; "
+            "the scheduler must substitute a ShuffleReaderExec"
+        )
+
+    def fmt(self) -> str:
+        return f"UnresolvedShuffleExec: stage={self.stage_id}, partitions={self.partition_count}"

@@ -1,0 +1,138 @@
+"""Executor poll loop + task execution.
+
+The reference's pull model (rust/executor/src/execution_loop.rs): every 250ms
+the executor calls PollWork with its metadata, whether it can accept a task,
+and the statuses of tasks that finished since the last poll (heartbeat and
+work queue in one RPC). Returned TaskDefinitions are decoded and run on a
+bounded task pool; results become Completed/Failed statuses pushed on the
+next poll (ref as_task_status, execution_loop.rs:112-140).
+
+Unlike the reference, task execution happens in-process rather than through
+a loopback Flight call to the executor's own data plane
+(ref execution_loop.rs:93-101 + the NOTE at flight_service.rs:90-91 saying
+exactly this should happen).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+import traceback
+from typing import Optional
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.distributed.stages import ShuffleWriterExec
+from ballista_tpu.executor.flight_service import flight_shuffle_fetcher
+from ballista_tpu.physical.plan import TaskContext
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu.scheduler.rpc import SchedulerGrpcClient
+
+log = logging.getLogger("ballista.executor")
+
+POLL_INTERVAL_SECS = 0.25  # ref execution_loop.rs:75
+
+
+class PollLoop:
+    def __init__(
+        self,
+        scheduler: SchedulerGrpcClient,
+        metadata: pb.ExecutorMetadata,
+        work_dir: str,
+        config: Optional[BallistaConfig] = None,
+        concurrent_tasks: int = 4,  # ref executor_config_spec.toml default
+    ) -> None:
+        self.scheduler = scheduler
+        self.metadata = metadata
+        self.work_dir = work_dir
+        self.config = config or BallistaConfig()
+        self.concurrent_tasks = concurrent_tasks
+        self._available = threading.Semaphore(concurrent_tasks)
+        self._finished: "queue.Queue[pb.TaskStatus]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:
+                # repeated poll failure only warns (ref execution_loop.rs:70-72)
+                log.warning("poll failed: %s", e)
+            self._stop.wait(POLL_INTERVAL_SECS)
+
+    # ------------------------------------------------------------------
+    def _drain_statuses(self):
+        out = []
+        while True:
+            try:
+                out.append(self._finished.get_nowait())
+            except queue.Empty:
+                return out
+
+    def poll_once(self) -> bool:
+        """One PollWork round; returns True if a task was received."""
+        can_accept = self._available.acquire(blocking=False)
+        if can_accept:
+            self._available.release()
+        params = pb.PollWorkParams(
+            metadata=self.metadata, can_accept_task=can_accept
+        )
+        for st in self._drain_statuses():
+            params.task_status.add().CopyFrom(st)
+        result = self.scheduler.poll_work(params)
+        if result.HasField("task"):
+            self._available.acquire()
+            threading.Thread(
+                target=self._run_task, args=(result.task,), daemon=True
+            ).start()
+            return True
+        return False
+
+    def _run_task(self, task: pb.TaskDefinition) -> None:
+        from ballista_tpu.serde.physical import phys_plan_from_proto
+
+        pid = task.task_id
+        status = pb.TaskStatus()
+        status.partition_id.CopyFrom(pid)
+        try:
+            plan = phys_plan_from_proto(task.plan)
+            if not isinstance(plan, ShuffleWriterExec):
+                plan = ShuffleWriterExec(pid.job_id, pid.stage_id, plan, None)
+            ctx = TaskContext(
+                config=self.config,
+                work_dir=self.work_dir,
+                job_id=pid.job_id,
+                shuffle_fetcher=flight_shuffle_fetcher,
+            )
+            stats = plan.execute_shuffle_write(pid.partition_id, ctx)
+            base = os.path.join(
+                self.work_dir, pid.job_id, str(pid.stage_id), str(pid.partition_id)
+            )
+            status.completed.executor_id = self.metadata.id
+            status.completed.path = base
+            status.completed.stats.num_rows = stats.num_rows
+            status.completed.stats.num_batches = stats.num_batches
+            status.completed.stats.num_bytes = stats.num_bytes
+            log.info(
+                "task %s/%s/%s completed (%d rows)",
+                pid.job_id, pid.stage_id, pid.partition_id, stats.num_rows,
+            )
+        except Exception as e:
+            log.error("task %s failed: %s", pid, traceback.format_exc())
+            status.failed.error = f"{type(e).__name__}: {e}"
+        finally:
+            self._available.release()
+        self._finished.put(status)

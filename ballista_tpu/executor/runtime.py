@@ -1,0 +1,114 @@
+"""Executor process assembly + standalone (local) cluster.
+
+BallistaExecutor ties together the Flight data plane and the poll loop
+(reference rust/executor/src/main.rs). start_standalone_cluster is the
+`--local` mode equivalent (ref main.rs:101-138): an in-process scheduler on
+an embedded KV backend plus N executors, all in one process.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import tempfile
+import threading
+import uuid
+from typing import List, Optional, Tuple
+
+import grpc
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.executor.execution_loop import PollLoop
+from ballista_tpu.executor.flight_service import BallistaFlightService
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu.scheduler.kv import KvBackend, MemoryBackend
+from ballista_tpu.scheduler.rpc import SchedulerGrpcClient
+from ballista_tpu.scheduler.server import SchedulerServer, serve
+
+log = logging.getLogger("ballista.executor")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class BallistaExecutor:
+    """One executor: Flight server + poll loop + work dir
+    (ref BallistaExecutor/ExecutorConfig, rust/executor/src/lib.rs:20-49)."""
+
+    def __init__(
+        self,
+        scheduler_host: str,
+        scheduler_port: int,
+        external_host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        work_dir: Optional[str] = None,
+        concurrent_tasks: int = 4,
+        config: Optional[BallistaConfig] = None,
+        executor_id: Optional[str] = None,
+    ) -> None:
+        self.id = executor_id or str(uuid.uuid4())
+        self.host = external_host
+        self.port = port or _free_port()
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="ballista-executor-")
+        self.config = config or BallistaConfig()
+        self.flight = BallistaFlightService(
+            f"grpc://0.0.0.0:{self.port}", self.work_dir, self.config
+        )
+        self._flight_thread = threading.Thread(target=self.flight.serve, daemon=True)
+        self.scheduler_client = SchedulerGrpcClient(scheduler_host, scheduler_port)
+        meta = pb.ExecutorMetadata(id=self.id, host=self.host, port=self.port)
+        self.poll_loop = PollLoop(
+            self.scheduler_client,
+            meta,
+            self.work_dir,
+            config=self.config,
+            concurrent_tasks=concurrent_tasks,
+        )
+
+    def start(self) -> None:
+        self._flight_thread.start()
+        self.poll_loop.start()
+        log.info("executor %s serving flight on port %s", self.id, self.port)
+
+    def stop(self) -> None:
+        self.poll_loop.stop()
+        self.flight.shutdown()
+        self.scheduler_client.close()
+
+
+class StandaloneCluster:
+    """In-process scheduler + N executors (ref --local mode)."""
+
+    def __init__(
+        self,
+        n_executors: int = 2,
+        kv: Optional[KvBackend] = None,
+        config: Optional[BallistaConfig] = None,
+        concurrent_tasks: int = 4,
+    ) -> None:
+        self.config = config or BallistaConfig()
+        self.scheduler_impl = SchedulerServer(kv or MemoryBackend(), config=self.config)
+        self.port = _free_port()
+        self.grpc_server = serve(self.scheduler_impl, "127.0.0.1", self.port)
+        self.executors: List[BallistaExecutor] = []
+        for _ in range(n_executors):
+            ex = BallistaExecutor(
+                "127.0.0.1",
+                self.port,
+                config=self.config,
+                concurrent_tasks=concurrent_tasks,
+            )
+            ex.start()
+            self.executors.append(ex)
+
+    @property
+    def scheduler_addr(self) -> Tuple[str, int]:
+        return ("127.0.0.1", self.port)
+
+    def shutdown(self) -> None:
+        for ex in self.executors:
+            ex.stop()
+        self.grpc_server.stop(grace=None)

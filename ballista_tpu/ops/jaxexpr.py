@@ -1,0 +1,373 @@
+"""PhysicalExpr -> JAX compiler.
+
+Lowers an expression tree to a function evaluated inside jit over device
+columns. String semantics run over dictionary codes: equality against a
+literal becomes a code comparison, LIKE / IN become boolean table gathers
+where the table is computed host-side over the (small) dictionary and passed
+as a runtime argument (so a growing dictionary never retraces the program —
+tables are padded to power-of-two sizes).
+
+This is where the reference's per-row Arrow compute kernels (DataFusion
+PhysicalExpr) become branch-free vectorized TPU code.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ballista_tpu.ops.runtime import ColumnDictionary, ScanDictionaries, UnsupportedOnDevice
+from ballista_tpu.physical import expr as px
+
+# cols: Dict[int, jnp.ndarray]; aux: List[jnp.ndarray]
+EvalFn = Callable[[Dict[int, "jnp.ndarray"], List["jnp.ndarray"]], "jnp.ndarray"]
+
+
+class CompiledValue:
+    def __init__(self, kind: str, fn: EvalFn,
+                 dictionary: Optional[ColumnDictionary] = None) -> None:
+        assert kind in ("num", "bool", "code")
+        self.kind = kind
+        self.fn = fn
+        self.dictionary = dictionary
+
+
+class ExprCompiler:
+    """Compiles expressions; records which column indices are needed and the
+    aux providers (host-side per-batch table builders)."""
+
+    def __init__(self, schema: pa.Schema, dicts: ScanDictionaries) -> None:
+        self.schema = schema
+        self.dicts = dicts
+        self.used_columns: Dict[int, pa.DataType] = {}
+        self.aux_providers: List[Callable[[], np.ndarray]] = []
+
+    # ------------------------------------------------------------------
+    def _add_aux(self, provider: Callable[[], np.ndarray]) -> int:
+        self.aux_providers.append(provider)
+        return len(self.aux_providers) - 1
+
+    def build_aux(self) -> List[np.ndarray]:
+        return [p() for p in self.aux_providers]
+
+    # ------------------------------------------------------------------
+    def compile(self, e: px.PhysicalExpr) -> CompiledValue:
+        import jax.numpy as jnp
+
+        if isinstance(e, px.ColumnExpr):
+            idx = e.index
+            dtype = self.schema.field(idx).type
+            if pa.types.is_dictionary(dtype):
+                dtype = dtype.value_type
+            self.used_columns[idx] = dtype
+            if pa.types.is_string(dtype) or pa.types.is_large_string(dtype):
+                d = self.dicts.for_column(idx)
+                return CompiledValue("code", lambda cols, aux, i=idx: cols[i], d)
+            if pa.types.is_boolean(dtype):
+                return CompiledValue("bool", lambda cols, aux, i=idx: cols[i])
+            return CompiledValue("num", lambda cols, aux, i=idx: cols[i])
+
+        if isinstance(e, px.LiteralExpr):
+            v = e.value
+            if isinstance(v, bool):
+                return CompiledValue("bool", lambda cols, aux, c=v: jnp.asarray(c))
+            if isinstance(v, (int, float)):
+                dt = np.float32 if isinstance(v, float) else np.int32
+                return CompiledValue(
+                    "num", lambda cols, aux, c=dt(v): jnp.asarray(c)
+                )
+            if isinstance(v, datetime.date) and not isinstance(v, datetime.datetime):
+                days = np.int32((v - datetime.date(1970, 1, 1)).days)
+                return CompiledValue("num", lambda cols, aux, c=days: jnp.asarray(c))
+            if isinstance(v, str):
+                # bare string literal: only meaningful inside comparisons,
+                # which intercept it before compiling this node
+                raise UnsupportedOnDevice("free-standing string literal")
+            raise UnsupportedOnDevice(f"literal {v!r}")
+
+        if isinstance(e, px.BinaryPhysicalExpr):
+            return self._compile_binary(e)
+
+        if isinstance(e, px.NotExpr):
+            inner = self.compile(e.expr)
+            return CompiledValue(
+                "bool", lambda cols, aux, f=inner.fn: jnp.logical_not(f(cols, aux))
+            )
+
+        if isinstance(e, px.NegativeExpr):
+            inner = self.compile(e.expr)
+            return CompiledValue("num", lambda cols, aux, f=inner.fn: -f(cols, aux))
+
+        if isinstance(e, px.IsNullExpr):
+            # device columns are null-free by construction (runtime rejects
+            # nullable batches)
+            const = bool(e.negated)  # IS NOT NULL -> True, IS NULL -> False
+
+            def isnull_fn(cols, aux, c=const):
+                return jnp.asarray(c)
+
+            return CompiledValue("bool", isnull_fn)
+
+        if isinstance(e, px.BetweenExpr):
+            v = self.compile(e.expr)
+            lo = self.compile(e.low)
+            hi = self.compile(e.high)
+            if v.kind != "num":
+                raise UnsupportedOnDevice("BETWEEN on non-numeric")
+
+            def between_fn(cols, aux, vf=v.fn, lf=lo.fn, hf=hi.fn, neg=e.negated):
+                x = vf(cols, aux)
+                r = jnp.logical_and(x >= lf(cols, aux), x <= hf(cols, aux))
+                return jnp.logical_not(r) if neg else r
+
+            return CompiledValue("bool", between_fn)
+
+        if isinstance(e, px.InListExpr):
+            v = self.compile(e.expr)
+            if v.kind == "code":
+                d = v.dictionary
+                values = list(e.values)
+
+                def in_table() -> np.ndarray:
+                    n = max(1, len(d))
+                    from ballista_tpu.ops.runtime import bucket_rows
+
+                    table = np.zeros(bucket_rows(n, 16), dtype=np.bool_)
+                    if d.values is not None:
+                        member = pc.is_in(d.values, value_set=pa.array(values))
+                        table[: len(d)] = member.to_numpy(zero_copy_only=False)
+                    return table
+
+                slot = self._add_aux(in_table)
+
+                def inlist_code_fn(cols, aux, vf=v.fn, s=slot, neg=e.negated):
+                    r = aux[s][vf(cols, aux)]
+                    return jnp.logical_not(r) if neg else r
+
+                return CompiledValue("bool", inlist_code_fn)
+            # numeric IN list -> chained equality
+            consts = [self.compile(px.LiteralExpr(x, pa.float64() if isinstance(x, float) else pa.int64())) for x in e.values]
+
+            def inlist_num_fn(cols, aux, vf=v.fn, cs=consts, neg=e.negated):
+                x = vf(cols, aux)
+                r = jnp.zeros(x.shape, dtype=bool)
+                for c in cs:
+                    r = jnp.logical_or(r, x == c.fn(cols, aux))
+                return jnp.logical_not(r) if neg else r
+
+            return CompiledValue("bool", inlist_num_fn)
+
+        if isinstance(e, px.CaseExpr):
+            arms = []
+            for w, t in e.when_then:
+                cw = self.compile(w)
+                ct = self.compile(t)
+                if e.base is not None:
+                    raise UnsupportedOnDevice("CASE base form")
+                arms.append((cw, ct))
+            celse = self.compile(e.else_expr) if e.else_expr is not None else None
+
+            def case_fn(cols, aux, arms=arms, celse=celse):
+                out = (
+                    celse.fn(cols, aux)
+                    if celse is not None
+                    else jnp.asarray(np.float32(0))
+                )
+                for cw, ct in reversed(arms):
+                    out = jnp.where(cw.fn(cols, aux), ct.fn(cols, aux), out)
+                return out
+
+            kind = arms[0][1].kind
+            return CompiledValue(kind, case_fn)
+
+        if isinstance(e, px.CastExpr):
+            inner = self.compile(e.expr)
+            if pa.types.is_floating(e.dtype):
+                return CompiledValue(
+                    "num",
+                    lambda cols, aux, f=inner.fn: f(cols, aux).astype(jnp.float32),
+                )
+            if pa.types.is_integer(e.dtype):
+                return CompiledValue(
+                    "num",
+                    lambda cols, aux, f=inner.fn: f(cols, aux).astype(jnp.int32),
+                )
+            raise UnsupportedOnDevice(f"cast to {e.dtype}")
+
+        if isinstance(e, px.ScalarFunctionExpr):
+            return self._compile_function(e)
+
+        raise UnsupportedOnDevice(f"expr {type(e).__name__}")
+
+    # ------------------------------------------------------------------
+    def _compile_binary(self, e: px.BinaryPhysicalExpr) -> CompiledValue:
+        import jax.numpy as jnp
+
+        op = e.op
+        # string comparisons / LIKE against literals -> dictionary space
+        if op in ("eq", "neq", "like", "not_like"):
+            sv = self._try_string_side(e.left, e.right, op)
+            if sv is not None:
+                return sv
+        if op in ("and", "or"):
+            l = self.compile(e.left)
+            r = self.compile(e.right)
+            jop = jnp.logical_and if op == "and" else jnp.logical_or
+            return CompiledValue(
+                "bool", lambda cols, aux, lf=l.fn, rf=r.fn, j=jop: j(lf(cols, aux), rf(cols, aux))
+            )
+        l = self.compile(e.left)
+        r = self.compile(e.right)
+        if op in ("eq", "neq") and l.kind == "code" and r.kind == "code":
+            if l.dictionary is not r.dictionary:
+                raise UnsupportedOnDevice("code comparison across dictionaries")
+            fn = (lambda a, b: a == b) if op == "eq" else (lambda a, b: a != b)
+            return CompiledValue(
+                "bool", lambda cols, aux, lf=l.fn, rf=r.fn, f=fn: f(lf(cols, aux), rf(cols, aux))
+            )
+        if l.kind == "code" or r.kind == "code":
+            raise UnsupportedOnDevice(f"string operands for {op}")
+        cmps = {
+            "eq": lambda a, b: a == b,
+            "neq": lambda a, b: a != b,
+            "lt": lambda a, b: a < b,
+            "lteq": lambda a, b: a <= b,
+            "gt": lambda a, b: a > b,
+            "gteq": lambda a, b: a >= b,
+        }
+        if op in cmps:
+            return CompiledValue(
+                "bool",
+                lambda cols, aux, lf=l.fn, rf=r.fn, f=cmps[op]: f(lf(cols, aux), rf(cols, aux)),
+            )
+        ariths = {
+            "plus": lambda a, b: a + b,
+            "minus": lambda a, b: a - b,
+            "multiply": lambda a, b: a * b,
+            "divide": lambda a, b: a / b,
+            "modulo": lambda a, b: jnp.mod(a, b),
+        }
+        if op in ariths:
+            return CompiledValue(
+                "num",
+                lambda cols, aux, lf=l.fn, rf=r.fn, f=ariths[op]: f(lf(cols, aux), rf(cols, aux)),
+            )
+        raise UnsupportedOnDevice(f"binary op {op}")
+
+    def _try_string_side(
+        self, left: px.PhysicalExpr, right: px.PhysicalExpr, op: str
+    ) -> Optional[CompiledValue]:
+        """column-vs-string-literal comparisons in dictionary space."""
+        import jax.numpy as jnp
+
+        col, lit = left, right
+        if isinstance(left, px.LiteralExpr) and isinstance(left.value, str):
+            col, lit = right, left
+        if not (isinstance(lit, px.LiteralExpr) and isinstance(lit.value, str)):
+            return None
+        cv = self.compile(col)
+        if cv.kind != "code":
+            raise UnsupportedOnDevice("string literal vs non-string column")
+        d = cv.dictionary
+        pattern = lit.value
+
+        if op in ("eq", "neq"):
+            code_slot = self._add_aux(
+                lambda d=d, v=pattern: np.asarray(d.code_of(v), dtype=np.int32)
+            )
+
+            def eq_fn(cols, aux, f=cv.fn, s=code_slot, neg=(op == "neq")):
+                r = f(cols, aux) == aux[s]
+                return jnp.logical_not(r) if neg else r
+
+            return CompiledValue("bool", eq_fn)
+
+        # LIKE via host-computed match table over the dictionary
+        def like_table(d=d, pattern=pattern) -> np.ndarray:
+            from ballista_tpu.ops.runtime import bucket_rows
+
+            n = max(1, len(d))
+            table = np.zeros(bucket_rows(n, 16), dtype=np.bool_)
+            if d.values is not None:
+                m = pc.match_like(d.values, pattern)
+                table[: len(d)] = pc.fill_null(m, False).to_numpy(zero_copy_only=False)
+            return table
+
+        slot = self._add_aux(like_table)
+
+        def like_fn(cols, aux, f=cv.fn, s=slot, neg=(op == "not_like")):
+            r = aux[s][f(cols, aux)]
+            return jnp.logical_not(r) if neg else r
+
+        return CompiledValue("bool", like_fn)
+
+    # ------------------------------------------------------------------
+    def _compile_function(self, e: px.ScalarFunctionExpr) -> CompiledValue:
+        import jax.numpy as jnp
+
+        fn = e.fn
+        unary = {
+            "sqrt": jnp.sqrt, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+            "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+            "exp": jnp.exp, "ln": jnp.log, "log2": jnp.log2, "log10": jnp.log10,
+            "log": jnp.log10, "floor": jnp.floor, "ceil": jnp.ceil,
+            "round": jnp.round, "trunc": jnp.trunc, "abs": jnp.abs,
+            "signum": jnp.sign,
+        }
+        if fn in unary:
+            inner = self.compile(e.args[0])
+            return CompiledValue(
+                "num", lambda cols, aux, f=inner.fn, j=unary[fn]: j(f(cols, aux))
+            )
+        if fn in ("extract", "date_part"):
+            part = e.args[0]
+            if not isinstance(part, px.LiteralExpr):
+                raise UnsupportedOnDevice("extract part must be literal")
+            inner = self.compile(e.args[1])
+            pname = str(part.value).lower()
+            if pname == "year":
+                return CompiledValue(
+                    "num",
+                    lambda cols, aux, f=inner.fn: _civil_from_days(f(cols, aux))[0],
+                )
+            if pname == "month":
+                return CompiledValue(
+                    "num",
+                    lambda cols, aux, f=inner.fn: _civil_from_days(f(cols, aux))[1],
+                )
+            if pname == "day":
+                return CompiledValue(
+                    "num",
+                    lambda cols, aux, f=inner.fn: _civil_from_days(f(cols, aux))[2],
+                )
+            raise UnsupportedOnDevice(f"extract {pname}")
+        if fn == "coalesce":
+            # null-free device path: first argument wins
+            return self.compile(e.args[0])
+        raise UnsupportedOnDevice(f"scalar function {fn}")
+
+
+def _civil_from_days(days):
+    """Howard Hinnant's civil_from_days: days since 1970-01-01 -> (y, m, d).
+    Pure integer arithmetic — vectorizes cleanly on the VPU."""
+    import jax.numpy as jnp
+
+    z = days.astype(jnp.int32) + 719468
+    era = jnp.floor_divide(jnp.where(z >= 0, z, z - 146096), 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36524)
+        - jnp.floor_divide(doe, 146096),
+        365,
+    )
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d

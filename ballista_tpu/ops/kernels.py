@@ -1,0 +1,148 @@
+"""Device kernel entry points used by operator dispatch.
+
+hash_aggregate is the headline: whole-pipeline fusion via FusedAggregateStage.
+filter_batch / project_batch are per-batch lowerings used when an operator
+runs outside a fusable aggregate pipeline; they return None (host fallback)
+for shapes the device path doesn't support.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.ops.jaxexpr import ExprCompiler
+from ballista_tpu.ops.runtime import (
+    ScanDictionaries,
+    UnsupportedOnDevice,
+    bucket_rows,
+    column_to_numpy,
+    pad_to,
+)
+
+_stage_cache: Dict[str, object] = {}
+_filter_cache: Dict[int, Tuple[object, object]] = {}
+_cache_configured = False
+
+
+def _configure_jax_cache() -> None:
+    """Persistent XLA compilation cache: repeated queries (and repeated
+    bench/driver processes) skip recompilation — essential when the chip is
+    behind a remote-compile relay."""
+    global _cache_configured
+    if _cache_configured:
+        return
+    import pathlib
+
+    import jax
+
+    cache_dir = pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"
+    try:
+        cache_dir.mkdir(exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:
+        pass
+    _cache_configured = True
+
+
+def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
+    from ballista_tpu.ops.stage import FusedAggregateStage
+
+    _configure_jax_cache()
+    # structural cache: identical plan shapes (the common case for repeated
+    # queries) share one stage — and with it the jit trace/compile cache.
+    # Memory scans carry no identity in their display: include source ids so
+    # two in-memory tables with the same shape never collide.
+    import os
+
+    from ballista_tpu.physical.scan import MemoryScanExec
+
+    node = exec_node
+    while node.children():
+        node = node.children()[0]
+    if isinstance(node, MemoryScanExec):
+        suffix = str(id(node.source))
+    elif hasattr(node, "source") and hasattr(node.source, "files"):
+        # include file mtimes so a rewritten file invalidates the cached
+        # stage (and its device-resident columns)
+        suffix = ",".join(
+            f"{f}:{os.path.getmtime(f) if os.path.exists(f) else 0}"
+            for f in node.source.files
+        )
+    else:
+        suffix = ""
+    key = exec_node.display_indent() + "|" + suffix
+    stage = _stage_cache.get(key)
+    if stage is None:
+        try:
+            stage = FusedAggregateStage(exec_node)
+        except UnsupportedOnDevice:
+            _stage_cache[key] = False
+            return None
+        _stage_cache[key] = stage
+    if stage is False:
+        return None
+    try:
+        return stage.run(partition, ctx)
+    except UnsupportedOnDevice:
+        _stage_cache[key] = False
+        return None
+
+
+def _compile_predicate(predicate, schema: pa.Schema):
+    key = id(predicate)
+    hit = _filter_cache.get(key)
+    if hit is not None:
+        return hit
+    try:
+        dicts = ScanDictionaries()
+        compiler = ExprCompiler(schema, dicts)
+        cv = compiler.compile(predicate)
+        if cv.kind != "bool":
+            raise UnsupportedOnDevice("non-boolean predicate")
+        import jax
+
+        @jax.jit
+        def run(cols, aux):
+            return cv.fn(cols, aux)
+
+        hit = (compiler, run)
+    except UnsupportedOnDevice:
+        hit = False
+    _filter_cache[key] = hit
+    return hit
+
+
+def filter_batch(batch: pa.RecordBatch, predicate) -> Optional[pa.RecordBatch]:
+    """Evaluate the predicate on device, compact on host."""
+    import jax.numpy as jnp
+
+    schema = batch.schema
+    hit = _compile_predicate(predicate, schema)
+    if hit is False:
+        return None
+    compiler, run = hit
+    n = batch.num_rows
+    bucket = bucket_rows(n)
+    try:
+        cols = {}
+        for idx, dtype in compiler.used_columns.items():
+            d = compiler.dicts.dicts.get(idx)
+            npcol = column_to_numpy(batch.column(idx), dtype, d)
+            fill = False if npcol.dtype == np.bool_ else 0
+            cols[idx] = jnp.asarray(pad_to(npcol, bucket, fill))
+    except UnsupportedOnDevice:
+        return None
+    aux = [jnp.asarray(a) for a in compiler.build_aux()]
+    mask = np.asarray(run(cols, aux))[:n]
+    return batch.filter(pa.array(mask))
+
+
+def project_batch(batch: pa.RecordBatch, exprs, schema: pa.Schema) -> Optional[pa.RecordBatch]:
+    # per-batch device projection pays transfer both ways without fusion
+    # around it; the fused-stage path covers the cases that matter. Host path.
+    return None

@@ -1,0 +1,142 @@
+"""Arrow <-> device runtime: column transfer, dictionary encoding, padding.
+
+TPU-first data discipline (SURVEY §7 "TPU operator lowering"):
+- strings never reach the device as bytes: each string column is encoded to
+  int32 codes against a per-scan growing dictionary; predicates on strings
+  become code comparisons / table gathers; group keys aggregate over codes
+  and decode at the end
+- float64 narrows to float32 (TPU vector unit native; f64 is emulated and
+  slow), int64 narrows to int32 after a range check, date32 is int32 days
+- batches are padded to power-of-two row buckets so XLA compiles a bounded
+  set of program shapes (recompilation control)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ballista_tpu.errors import ExecutionError
+
+
+class UnsupportedOnDevice(Exception):
+    """Raised when a column/expr can't lower to the device path; callers
+    fall back to the host Arrow kernels."""
+
+
+class ColumnDictionary:
+    """Growing per-column dictionary mapping values -> stable int32 codes."""
+
+    def __init__(self) -> None:
+        self.values: Optional[pa.Array] = None  # accumulated distinct values
+
+    def encode(self, arr: pa.Array) -> np.ndarray:
+        """Encode an Arrow array to codes against this dictionary, extending
+        it with novel values. Nulls -> -1."""
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if isinstance(arr, pa.DictionaryArray):
+            d = arr  # parquet dictionary pages: codes come for free
+        else:
+            d = pc.dictionary_encode(arr)
+        if isinstance(d, pa.ChunkedArray):
+            d = d.combine_chunks()
+        local_values = d.dictionary
+        local_codes = d.indices.to_numpy(zero_copy_only=False).astype(np.int64)
+        if d.indices.null_count:
+            mask = d.indices.is_valid().to_numpy(zero_copy_only=False)
+            local_codes = np.where(mask, local_codes, -1)
+        if self.values is None:
+            self.values = local_values
+            remap = np.arange(len(local_values), dtype=np.int64)
+        else:
+            idx = pc.index_in(local_values, value_set=self.values)
+            idx_np = idx.to_numpy(zero_copy_only=False).astype(np.float64)
+            missing = np.isnan(idx_np)
+            if missing.any():
+                novel = local_values.filter(pa.array(missing))
+                base = len(self.values)
+                self.values = pa.concat_arrays(
+                    [self.values.cast(novel.type), novel]
+                )
+                idx_np = np.where(
+                    missing, base + np.cumsum(missing) - 1, idx_np
+                )
+            remap = idx_np.astype(np.int64)
+        out = np.where(local_codes >= 0, remap[np.maximum(local_codes, 0)], -1)
+        return out.astype(np.int32)
+
+    def code_of(self, value) -> int:
+        """Code for a literal, extending the dictionary so it always exists."""
+        if self.values is None:
+            self.values = pa.array([value])
+            return 0
+        idx = pc.index_in(pa.scalar(value, type=self.values.type), value_set=self.values)
+        if idx.as_py() is None:
+            self.values = pa.concat_arrays([self.values, pa.array([value], type=self.values.type)])
+            return len(self.values) - 1
+        return int(idx.as_py())
+
+    def __len__(self) -> int:
+        return 0 if self.values is None else len(self.values)
+
+
+class ScanDictionaries:
+    """Per-scan registry of ColumnDictionary keyed by column index."""
+
+    def __init__(self) -> None:
+        self.dicts: Dict[int, ColumnDictionary] = {}
+
+    def for_column(self, index: int) -> ColumnDictionary:
+        if index not in self.dicts:
+            self.dicts[index] = ColumnDictionary()
+        return self.dicts[index]
+
+
+def bucket_rows(n: int, minimum: int = 1024) -> int:
+    """Pad row counts to power-of-two buckets to bound XLA recompilation."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+_INT32_MIN = -(2**31)
+_INT32_MAX = 2**31 - 1
+
+
+def column_to_numpy(
+    arr: pa.Array, dtype: pa.DataType, dictionary: Optional[ColumnDictionary]
+) -> np.ndarray:
+    """Lower one Arrow column to a device-ready numpy array (no nulls)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if arr.null_count:
+        raise UnsupportedOnDevice("null values in device column")
+    if pa.types.is_string(dtype) or pa.types.is_large_string(dtype):
+        assert dictionary is not None
+        return dictionary.encode(arr)
+    if pa.types.is_floating(dtype):
+        return arr.to_numpy(zero_copy_only=False).astype(np.float32)
+    if pa.types.is_date(dtype):
+        return arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+    if pa.types.is_integer(dtype):
+        vals = arr.to_numpy(zero_copy_only=False)
+        if vals.dtype.itemsize > 4:
+            if len(vals) and (vals.min() < _INT32_MIN or vals.max() > _INT32_MAX):
+                raise UnsupportedOnDevice("int64 values exceed int32 range")
+            vals = vals.astype(np.int32)
+        return vals
+    if pa.types.is_boolean(dtype):
+        return arr.to_numpy(zero_copy_only=False).astype(np.bool_)
+    raise UnsupportedOnDevice(f"unsupported device dtype {dtype}")
+
+
+def pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
+    if len(arr) == n:
+        return arr
+    pad = np.full(n - len(arr), fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad])

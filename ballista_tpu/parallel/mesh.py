@@ -1,0 +1,30 @@
+"""Device mesh construction.
+
+The reference scales by running N independent executor processes, one task
+per partition (docs/architecture.md:17-18). The TPU-native equivalent: one
+SPMD program over a jax.sharding.Mesh, partitions mapping to mesh shards,
+exchanges to XLA collectives over ICI (SURVEY §2.8 mapping table).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def build_mesh(shape: Optional[Dict[str, int]] = None, devices=None):
+    """Build a Mesh. shape e.g. {"data": 8}; defaults to all devices on one
+    'data' axis (row parallelism — a query engine's natural axis)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if not shape:
+        shape = {"data": len(devices)}
+    total = int(np.prod(list(shape.values())))
+    if total > len(devices):
+        raise ValueError(f"mesh {shape} needs {total} devices, have {len(devices)}")
+    devs = np.array(devices[:total]).reshape(tuple(shape.values()))
+    return Mesh(devs, tuple(shape.keys()))

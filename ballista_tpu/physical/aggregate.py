@@ -123,6 +123,12 @@ class HashAggregateExec(ExecutionPlan):
     def output_partitioning(self) -> Partitioning:
         if self.mode == AggregateMode.PARTIAL:
             return self.input.output_partitioning()
+        if self.mode == AggregateMode.FINAL:
+            # final aggregation runs per input partition (the planner ensures
+            # keys are hash-disjoint across partitions, or input is merged)
+            return Partitioning.unknown(
+                self.input.output_partitioning().partition_count()
+            )
         return Partitioning.unknown(1)
 
     def children(self) -> List[ExecutionPlan]:
@@ -137,6 +143,10 @@ class HashAggregateExec(ExecutionPlan):
             from ballista_tpu.ops.dispatch import tpu_hash_aggregate
             out = tpu_hash_aggregate(self, partition, ctx)
             if out is not None:
+                if self.mode == AggregateMode.SINGLE:
+                    # the fused stage produces partial states; merge them to
+                    # final values with the host merge (tiny input)
+                    out = self._final(out)
                 yield from batch_table(out, ctx.batch_size)
                 return
         table = collect_partition(self.input, partition, ctx)

@@ -181,7 +181,18 @@ class PhysicalPlanner:
             return HashAggregateExec(AggregateMode.SINGLE, merged, group_exprs, funcs)
 
         partial = HashAggregateExec(AggregateMode.PARTIAL, input, group_exprs, funcs)
-        merged = partial if partial.output_partitioning().partition_count() == 1 else MergeExec(partial)
+        if group_exprs:
+            # parallel final: hash-exchange partial states on the group keys,
+            # then finalize per partition (keys are disjoint across
+            # partitions). The reference merges to one partition instead
+            # (rust/scheduler/src/planner.rs:149-171 + MergeExec).
+            n = partial.output_partitioning().partition_count()
+            key_cols = [
+                ColumnExpr(name, i) for i, (_, name) in enumerate(group_exprs)
+            ]
+            exchange = RepartitionExec(partial, Partitioning.hash(key_cols, n))
+            return HashAggregateExec(AggregateMode.FINAL, exchange, group_exprs, funcs)
+        merged = MergeExec(partial)
         return HashAggregateExec(AggregateMode.FINAL, merged, group_exprs, funcs)
 
     # ------------------------------------------------------------------

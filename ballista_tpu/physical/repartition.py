@@ -39,7 +39,11 @@ def hash_rows(arrays: List[pa.Array], num_partitions: int) -> np.ndarray:
     acc = np.zeros(n, dtype=np.uint64)
     for arr in arrays:
         a = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
-        if pa.types.is_integer(a.type) or pa.types.is_date(a.type) or pa.types.is_boolean(a.type):
+        if pa.types.is_date32(a.type):
+            a = a.cast(pa.int32())
+        elif pa.types.is_date64(a.type) or pa.types.is_timestamp(a.type):
+            a = a.cast(pa.int64())
+        if pa.types.is_integer(a.type) or pa.types.is_boolean(a.type):
             vals = pc.cast(a, pa.int64()).to_numpy(zero_copy_only=False).astype(np.int64)
             h = _splitmix64(vals.view(np.uint64) if vals.dtype == np.int64 else vals.astype(np.uint64))
         elif pa.types.is_floating(a.type):

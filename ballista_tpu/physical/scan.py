@@ -6,11 +6,46 @@ One partition per input file, as the reference's DataFusion scans do
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import pyarrow as pa
 import pyarrow.csv
 import pyarrow.parquet
+
+# host decoded-table cache (parquet), capped by total bytes, FIFO-evicted.
+# Keys are (path, mtime, cols); a rewritten file gets a new key and the old
+# entry for the same (path, cols) is dropped eagerly.
+import threading as _threading
+
+_TABLE_CACHE: Dict[tuple, pa.Table] = {}
+_TABLE_CACHE_BYTES = [0]
+_TABLE_CACHE_CAP = 16 << 30
+_TABLE_CACHE_MU = _threading.Lock()
+
+
+def _cache_get(key: tuple) -> Optional[pa.Table]:
+    with _TABLE_CACHE_MU:
+        return _TABLE_CACHE.get(key)
+
+
+def _maybe_cache(key: tuple, table: pa.Table) -> None:
+    nbytes = table.nbytes
+    if nbytes > _TABLE_CACHE_CAP:
+        return
+    with _TABLE_CACHE_MU:
+        # drop stale entries for the same (path, cols) with older mtimes
+        path, _mtime, cols = key
+        for k in [k for k in _TABLE_CACHE if k[0] == path and k[2] == cols and k != key]:
+            _TABLE_CACHE_BYTES[0] -= _TABLE_CACHE[k].nbytes
+            del _TABLE_CACHE[k]
+        # FIFO eviction to fit
+        while _TABLE_CACHE_BYTES[0] + nbytes > _TABLE_CACHE_CAP and _TABLE_CACHE:
+            k = next(iter(_TABLE_CACHE))
+            _TABLE_CACHE_BYTES[0] -= _TABLE_CACHE[k].nbytes
+            del _TABLE_CACHE[k]
+        _TABLE_CACHE[key] = table
+        _TABLE_CACHE_BYTES[0] += nbytes
 
 from ballista_tpu.datasource import CsvTableSource, MemoryTableSource, ParquetTableSource
 from ballista_tpu.physical.plan import ExecutionPlan, Partitioning, TaskContext, batch_table
@@ -76,8 +111,19 @@ class ParquetScanExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         path = self.source.files[partition]
-        pf = pa.parquet.ParquetFile(path)
         cols = self._schema.names if self.projection is not None else None
+        # decoded-table cache: repeated queries skip parquet decode (the
+        # host-side analog of the device column cache). Files too large to
+        # ever fit stream instead of materializing.
+        if ctx.config.scan_cache() and os.path.getsize(path) * 4 <= _TABLE_CACHE_CAP:
+            key = (path, os.path.getmtime(path), tuple(cols) if cols else None)
+            table = _cache_get(key)
+            if table is None:
+                table = pa.parquet.read_table(path, columns=cols)
+                _maybe_cache(key, table)
+            yield from table.to_batches(max_chunksize=ctx.batch_size)
+            return
+        pf = pa.parquet.ParquetFile(path)
         for batch in pf.iter_batches(batch_size=ctx.batch_size, columns=cols):
             yield batch
 

@@ -1,0 +1,209 @@
+"""Cluster-state KV backends.
+
+The reference abstracts all scheduler state behind a KV trait with two
+implementations — etcd (distributed) and sled (embedded) — plus a global
+lock (reference rust/scheduler/src/state/mod.rs:46-59, etcd.rs, standalone.rs).
+Here:
+
+- MemoryBackend: in-process dict (tests, --local mode)
+- SqliteBackend: embedded durable store (the sled role; sqlite3 is the
+  native embedded engine shipped with CPython)
+- EtcdBackend: stub that activates only if a python etcd client is present
+  (none is baked into this image; the trait boundary is what matters)
+
+Leases: keys may carry an expiry; expired keys are invisible to get/scan
+(the reference gives executor registrations a 60s lease, state/mod.rs:42).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sqlite3
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class KvBackend:
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        raise NotImplementedError
+
+    def put(self, key: str, value: bytes, lease_seconds: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> None:
+        raise NotImplementedError
+
+    @contextlib.contextmanager
+    def lock(self) -> Iterator[None]:
+        """Global scheduler lock (ref /ballista_global_lock)."""
+        raise NotImplementedError
+
+
+class MemoryBackend(KvBackend):
+    def __init__(self) -> None:
+        self._data: Dict[str, Tuple[bytes, Optional[float]]] = {}
+        self._mu = threading.RLock()
+
+    def _live(self, key: str) -> Optional[bytes]:
+        item = self._data.get(key)
+        if item is None:
+            return None
+        value, expires = item
+        if expires is not None and time.time() > expires:
+            del self._data[key]
+            return None
+        return value
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._mu:
+            return self._live(key)
+
+    def get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        with self._mu:
+            out = []
+            for k in sorted(self._data):
+                if k.startswith(prefix):
+                    v = self._live(k)
+                    if v is not None:
+                        out.append((k, v))
+            return out
+
+    def put(self, key: str, value: bytes, lease_seconds: Optional[float] = None) -> None:
+        with self._mu:
+            expires = time.time() + lease_seconds if lease_seconds else None
+            self._data[key] = (value, expires)
+
+    def delete_prefix(self, prefix: str) -> None:
+        with self._mu:
+            for k in [k for k in self._data if k.startswith(prefix)]:
+                del self._data[k]
+
+    @contextlib.contextmanager
+    def lock(self) -> Iterator[None]:
+        with self._mu:
+            yield
+
+
+class SqliteBackend(KvBackend):
+    """Durable embedded store (the reference's sled role). A restarted
+    scheduler process resumes from the same DB file."""
+
+    def __init__(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._mu = threading.RLock()
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv ("
+            "key TEXT PRIMARY KEY, value BLOB NOT NULL, expires REAL)"
+        )
+        self._conn.commit()
+
+    @classmethod
+    def temporary(cls) -> "SqliteBackend":
+        """In-memory sqlite for tests (ref StandaloneClient::try_new_temporary)."""
+        obj = cls.__new__(cls)
+        obj._path = ":memory:"
+        obj._mu = threading.RLock()
+        obj._conn = sqlite3.connect(":memory:", check_same_thread=False)
+        obj._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv ("
+            "key TEXT PRIMARY KEY, value BLOB NOT NULL, expires REAL)"
+        )
+        obj._conn.commit()
+        return obj
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._mu:
+            row = self._conn.execute(
+                "SELECT value, expires FROM kv WHERE key = ?", (key,)
+            ).fetchone()
+            if row is None:
+                return None
+            value, expires = row
+            if expires is not None and time.time() > expires:
+                self._conn.execute("DELETE FROM kv WHERE key = ?", (key,))
+                self._conn.commit()
+                return None
+            return value
+
+    def get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        with self._mu:
+            rows = self._conn.execute(
+                "SELECT key, value, expires FROM kv WHERE key >= ? AND key < ? ORDER BY key",
+                (prefix, prefix + "￿"),
+            ).fetchall()
+            now = time.time()
+            out = []
+            for k, v, exp in rows:
+                if exp is not None and now > exp:
+                    continue
+                out.append((k, v))
+            return out
+
+    def put(self, key: str, value: bytes, lease_seconds: Optional[float] = None) -> None:
+        with self._mu:
+            expires = time.time() + lease_seconds if lease_seconds else None
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (key, value, expires) VALUES (?, ?, ?)",
+                (key, value, expires),
+            )
+            self._conn.commit()
+
+    def delete_prefix(self, prefix: str) -> None:
+        with self._mu:
+            self._conn.execute(
+                "DELETE FROM kv WHERE key >= ? AND key < ?",
+                (prefix, prefix + "￿"),
+            )
+            self._conn.commit()
+
+    @contextlib.contextmanager
+    def lock(self) -> Iterator[None]:
+        with self._mu:
+            yield
+
+
+class EtcdBackend(KvBackend):
+    """Distributed backend over etcd's v3 API. Activates only when a python
+    etcd3 client library is importable; the image ships none, so multi-
+    scheduler HA deployments bring their own (the trait is the contract)."""
+
+    def __init__(self, endpoints: str) -> None:
+        try:
+            import etcd3  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "etcd backend requires the 'etcd3' package; "
+                "use MemoryBackend or SqliteBackend instead"
+            ) from e
+        host, _, port = endpoints.partition(":")
+        self._client = etcd3.client(host=host, port=int(port or 2379))
+        self._lock_name = "/ballista_global_lock"
+
+    def get(self, key: str) -> Optional[bytes]:
+        value, _ = self._client.get(key)
+        return value
+
+    def get_prefix(self, prefix: str) -> List[Tuple[str, bytes]]:
+        return [
+            (meta.key.decode(), value)
+            for value, meta in self._client.get_prefix(prefix, sort_order="ascend")
+        ]
+
+    def put(self, key: str, value: bytes, lease_seconds: Optional[float] = None) -> None:
+        lease = self._client.lease(int(lease_seconds)) if lease_seconds else None
+        self._client.put(key, value, lease=lease)
+
+    def delete_prefix(self, prefix: str) -> None:
+        self._client.delete_prefix(prefix)
+
+    @contextlib.contextmanager
+    def lock(self) -> Iterator[None]:
+        with self._client.lock(self._lock_name):
+            yield

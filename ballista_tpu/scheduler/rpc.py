@@ -1,0 +1,78 @@
+"""gRPC plumbing for the SchedulerGrpc service.
+
+The service contract lives in ballista.proto (ref proto:594-605). The grpc
+codegen plugin isn't in this toolchain, so the server registration and the
+client stub are written over grpcio's generic API — same wire behavior as
+generated stubs (method paths /ballista.SchedulerGrpc/<Method>).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+
+from ballista_tpu.proto import ballista_pb2 as pb
+
+SERVICE_NAME = "ballista.SchedulerGrpc"
+
+_METHODS = {
+    "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
+    "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
+    "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
+    "GetExecutorsMetadata": (pb.GetExecutorMetadataParams, pb.GetExecutorMetadataResult),
+    "GetFileMetadata": (pb.GetFileMetadataParams, pb.GetFileMetadataResult),
+}
+
+
+def add_scheduler_service(server: grpc.Server, servicer) -> None:
+    handlers = {}
+    for name, (req_cls, resp_cls) in _METHODS.items():
+        method = getattr(servicer, name)
+
+        def make(method):
+            def handle(request, context):
+                return method(request, context)
+
+            return handle
+
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            make(method),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class SchedulerGrpcClient:
+    """Client stub (plays the role of tonic's generated SchedulerGrpcClient)."""
+
+    def __init__(self, host: str, port: int, channel: Optional[grpc.Channel] = None) -> None:
+        self.channel = channel or grpc.insecure_channel(f"{host}:{port}")
+        self._stubs = {}
+        for name, (req_cls, resp_cls) in _METHODS.items():
+            self._stubs[name] = self.channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
+
+    def execute_query(self, params: pb.ExecuteQueryParams) -> pb.ExecuteQueryResult:
+        return self._stubs["ExecuteQuery"](params)
+
+    def poll_work(self, params: pb.PollWorkParams) -> pb.PollWorkResult:
+        return self._stubs["PollWork"](params)
+
+    def get_job_status(self, params: pb.GetJobStatusParams) -> pb.GetJobStatusResult:
+        return self._stubs["GetJobStatus"](params)
+
+    def get_executors_metadata(self) -> pb.GetExecutorMetadataResult:
+        return self._stubs["GetExecutorsMetadata"](pb.GetExecutorMetadataParams())
+
+    def get_file_metadata(self, params: pb.GetFileMetadataParams) -> pb.GetFileMetadataResult:
+        return self._stubs["GetFileMetadata"](params)
+
+    def close(self) -> None:
+        self.channel.close()

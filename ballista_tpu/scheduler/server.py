@@ -1,0 +1,178 @@
+"""SchedulerServer: the control plane's 5 RPCs.
+
+Mirrors the reference's SchedulerServer (rust/scheduler/src/lib.rs:82-428):
+
+- ExecuteQuery: decode logical plan proto (or parse SQL), mint a 7-char
+  alphanumeric job id (ref lib.rs:262-269), persist Queued, then plan
+  asynchronously: optimize -> physical plan -> distributed stages -> persist
+  each stage plan + one pending TaskStatus per (stage, partition)
+  (ref lib.rs:288-401).
+- PollWork: executor heartbeat + piggy-backed task statuses + work pull,
+  the whole body under the global state lock (ref lib.rs:105-182).
+- GetJobStatus / GetExecutorsMetadata / GetFileMetadata (parquet-only
+  schema discovery, ref lib.rs:184-222).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import string
+import threading
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.distributed.planner import DistributedPlanner
+from ballista_tpu.engine.context import ExecutionContext
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu.scheduler.kv import KvBackend, MemoryBackend
+from ballista_tpu.scheduler.rpc import add_scheduler_service
+from ballista_tpu.scheduler.state import SchedulerState
+from ballista_tpu.serde.arrow import schema_to_ipc
+from ballista_tpu.serde.logical import plan_from_proto
+
+log = logging.getLogger("ballista.scheduler")
+
+
+def _job_id() -> str:
+    # 7 alphanumeric chars, first char alphabetic (ref lib.rs:262-269)
+    first = random.choice(string.ascii_lowercase)
+    rest = "".join(random.choices(string.ascii_lowercase + string.digits, k=6))
+    return first + rest
+
+
+class SchedulerServer:
+    def __init__(
+        self,
+        kv: Optional[KvBackend] = None,
+        namespace: str = "default",
+        config: Optional[BallistaConfig] = None,
+        synchronous_planning: bool = False,
+    ) -> None:
+        self.state = SchedulerState(kv or MemoryBackend(), namespace)
+        self.config = config or BallistaConfig()
+        # catalog for SQL queries arriving as text (CREATE EXTERNAL TABLE
+        # statements executed through the scheduler register here)
+        self.catalog = ExecutionContext(self.config)
+        self.synchronous_planning = synchronous_planning
+        self._lock = threading.Lock()
+
+    # -- RPC implementations ------------------------------------------------
+    def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None) -> pb.ExecuteQueryResult:
+        which = request.WhichOneof("query")
+        settings = {kv.key: kv.value for kv in request.settings}
+        config = BallistaConfig({**self.config.to_dict(), **settings})
+        if which == "logical_plan":
+            plan = plan_from_proto(request.logical_plan)
+        elif which == "sql":
+            from ballista_tpu.logical import plan as lp
+            from ballista_tpu.sql.planner import plan_sql
+
+            plan = plan_sql(request.sql, self.catalog)
+            if isinstance(plan, lp.CreateExternalTable):
+                self.catalog._create_external_table(plan)
+                return pb.ExecuteQueryResult(job_id="")
+        else:
+            raise ValueError("ExecuteQueryParams requires a plan or sql")
+
+        job_id = _job_id()
+        queued = pb.JobStatus()
+        queued.queued.SetInParent()
+        self.state.save_job_metadata(job_id, queued)
+
+        if self.synchronous_planning:
+            self._plan_job(job_id, plan, config)
+        else:
+            threading.Thread(
+                target=self._plan_job_safe, args=(job_id, plan, config), daemon=True
+            ).start()
+        return pb.ExecuteQueryResult(job_id=job_id)
+
+    def _plan_job_safe(self, job_id: str, plan, config) -> None:
+        try:
+            self._plan_job(job_id, plan, config)
+        except Exception as e:  # surface planning failure as job failure
+            log.exception("planning job %s failed", job_id)
+            failed = pb.JobStatus()
+            failed.failed.error = f"planning failed: {e}"
+            self.state.save_job_metadata(job_id, failed)
+
+    def _plan_job(self, job_id: str, plan, config) -> None:
+        ctx = ExecutionContext(config)
+        physical = ctx.create_physical_plan(plan)
+        stages = DistributedPlanner().plan_query_stages(job_id, physical)
+        for stage in stages:
+            self.state.save_stage_plan(job_id, stage.stage_id, stage)
+            n = stage.output_partitioning().partition_count()
+            for p in range(n):
+                pending = pb.TaskStatus()
+                pending.partition_id.job_id = job_id
+                pending.partition_id.stage_id = stage.stage_id
+                pending.partition_id.partition_id = p
+                self.state.save_task_status(pending)
+        running = pb.JobStatus()
+        running.running.SetInParent()
+        self.state.save_job_metadata(job_id, running)
+        log.info("job %s planned into %d stages", job_id, len(stages))
+
+    def PollWork(self, request: pb.PollWorkParams, context=None) -> pb.PollWorkResult:
+        with self.state.kv.lock():
+            self.state.save_executor_metadata(request.metadata)
+            jobs = set()
+            for ts in request.task_status:
+                self.state.save_task_status(ts)
+                jobs.add(ts.partition_id.job_id)
+            result = pb.PollWorkResult()
+            if request.can_accept_task:
+                assigned = self.state.assign_next_schedulable_task(request.metadata.id)
+                if assigned is not None:
+                    status, plan = assigned
+                    from ballista_tpu.serde.physical import phys_plan_to_proto
+
+                    result.task.task_id.CopyFrom(status.partition_id)
+                    result.task.plan.CopyFrom(phys_plan_to_proto(plan))
+            for job_id in jobs:
+                self.state.synchronize_job_status(job_id)
+            return result
+
+    def GetJobStatus(self, request: pb.GetJobStatusParams, context=None) -> pb.GetJobStatusResult:
+        status = self.state.get_job_metadata(request.job_id)
+        result = pb.GetJobStatusResult()
+        if status is not None:
+            result.status.CopyFrom(status)
+        return result
+
+    def GetExecutorsMetadata(self, request, context=None) -> pb.GetExecutorMetadataResult:
+        result = pb.GetExecutorMetadataResult()
+        for m in self.state.get_executors_metadata():
+            result.metadata.add().CopyFrom(m)
+        return result
+
+    def GetFileMetadata(self, request: pb.GetFileMetadataParams, context=None) -> pb.GetFileMetadataResult:
+        # parquet only, like the reference (lib.rs:184-222)
+        if request.file_type.lower() != "parquet":
+            raise ValueError("GetFileMetadata supports parquet only")
+        from ballista_tpu.datasource import ParquetTableSource
+
+        src = ParquetTableSource(request.path)
+        return pb.GetFileMetadataResult(
+            schema_ipc=schema_to_ipc(src.schema()),
+            num_partitions=src.num_partitions(),
+        )
+
+
+def serve(
+    server_impl: SchedulerServer, bind_host: str = "0.0.0.0", port: int = 50050
+) -> grpc.Server:
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    add_scheduler_service(server, server_impl)
+    bound = server.add_insecure_port(f"{bind_host}:{port}")
+    if bound == 0:
+        raise RuntimeError(f"cannot bind scheduler to {bind_host}:{port}")
+    server.start()
+    log.info("scheduler listening on %s:%s", bind_host, bound)
+    server._ballista_port = bound  # actual port when port=0
+    return server

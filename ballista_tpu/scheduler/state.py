@@ -1,0 +1,211 @@
+"""Scheduler cluster state over a KV backend.
+
+Mirrors the reference's SchedulerState (rust/scheduler/src/state/mod.rs):
+every piece of cluster state is a protobuf value under
+/ballista/{namespace}/... keys, so a restarted scheduler on a durable
+backend resumes mid-job. Key layout (ref state/mod.rs:387-434):
+
+    executors/{id}                  ExecutorMetadata (60s lease)
+    jobs/{job_id}                   JobStatus
+    stages/{job_id}/{stage_id}      PhysicalPlanNode (the stage plan)
+    tasks/{job_id}/{stage_id}/{p}   TaskStatus (empty oneof = pending)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ballista_tpu.distributed.planner import (
+    find_unresolved_shuffles,
+    remove_unresolved_shuffles,
+)
+from ballista_tpu.distributed.stages import ShuffleLocation, ShuffleWriterExec
+from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu.scheduler.kv import KvBackend
+from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
+
+EXECUTOR_LEASE_SECS = 60.0  # ref state/mod.rs:42
+
+
+class SchedulerState:
+    def __init__(self, kv: KvBackend, namespace: str = "default") -> None:
+        self.kv = kv
+        self.namespace = namespace
+
+    def _key(self, *parts: str) -> str:
+        return "/".join(("/ballista", self.namespace) + parts)
+
+    # -- executors ----------------------------------------------------------
+    def save_executor_metadata(self, meta: pb.ExecutorMetadata) -> None:
+        self.kv.put(
+            self._key("executors", meta.id),
+            meta.SerializeToString(),
+            lease_seconds=EXECUTOR_LEASE_SECS,
+        )
+
+    def get_executors_metadata(self) -> List[pb.ExecutorMetadata]:
+        out = []
+        for _k, v in self.kv.get_prefix(self._key("executors")):
+            m = pb.ExecutorMetadata()
+            m.ParseFromString(v)
+            out.append(m)
+        return out
+
+    def get_executor_metadata(self, executor_id: str) -> Optional[pb.ExecutorMetadata]:
+        v = self.kv.get(self._key("executors", executor_id))
+        if v is None:
+            return None
+        m = pb.ExecutorMetadata()
+        m.ParseFromString(v)
+        return m
+
+    # -- jobs -----------------------------------------------------------------
+    def save_job_metadata(self, job_id: str, status: pb.JobStatus) -> None:
+        self.kv.put(self._key("jobs", job_id), status.SerializeToString())
+
+    def get_job_metadata(self, job_id: str) -> Optional[pb.JobStatus]:
+        v = self.kv.get(self._key("jobs", job_id))
+        if v is None:
+            return None
+        s = pb.JobStatus()
+        s.ParseFromString(v)
+        return s
+
+    # -- stage plans ----------------------------------------------------------
+    def save_stage_plan(self, job_id: str, stage_id: int, plan) -> None:
+        msg = phys_plan_to_proto(plan)
+        self.kv.put(
+            self._key("stages", job_id, str(stage_id)), msg.SerializeToString()
+        )
+
+    def get_stage_plan(self, job_id: str, stage_id: int):
+        v = self.kv.get(self._key("stages", job_id, str(stage_id)))
+        if v is None:
+            return None
+        n = pb.PhysicalPlanNode()
+        n.ParseFromString(v)
+        return phys_plan_from_proto(n)
+
+    # -- tasks ------------------------------------------------------------------
+    def save_task_status(self, status: pb.TaskStatus) -> None:
+        pid = status.partition_id
+        self.kv.put(
+            self._key("tasks", pid.job_id, str(pid.stage_id), str(pid.partition_id)),
+            status.SerializeToString(),
+        )
+
+    def get_task_status(self, job_id: str, stage_id: int, partition: int) -> Optional[pb.TaskStatus]:
+        v = self.kv.get(self._key("tasks", job_id, str(stage_id), str(partition)))
+        if v is None:
+            return None
+        t = pb.TaskStatus()
+        t.ParseFromString(v)
+        return t
+
+    def get_job_tasks(self, job_id: str) -> List[pb.TaskStatus]:
+        out = []
+        for _k, v in self.kv.get_prefix(self._key("tasks", job_id)):
+            t = pb.TaskStatus()
+            t.ParseFromString(v)
+            out.append(t)
+        return out
+
+    def get_all_tasks(self) -> List[pb.TaskStatus]:
+        out = []
+        for _k, v in self.kv.get_prefix(self._key("tasks")):
+            t = pb.TaskStatus()
+            t.ParseFromString(v)
+            out.append(t)
+        return out
+
+    # -- scheduling ---------------------------------------------------------
+    def assign_next_schedulable_task(
+        self, executor_id: str
+    ) -> Optional[Tuple[pb.TaskStatus, object]]:
+        """Linear scan for a runnable pending task (ref state/mod.rs:182-260):
+        a task is runnable when every upstream stage it reads from has all
+        tasks completed. Marks it Running and returns (status, bound plan)."""
+        tasks = self.get_all_tasks()
+        by_stage: Dict[Tuple[str, int], List[pb.TaskStatus]] = {}
+        for t in tasks:
+            by_stage.setdefault(
+                (t.partition_id.job_id, t.partition_id.stage_id), []
+            ).append(t)
+
+        for task in tasks:
+            if task.WhichOneof("status") is not None:
+                continue  # already running/completed/failed
+            job_id = task.partition_id.job_id
+            stage_id = task.partition_id.stage_id
+            plan = self.get_stage_plan(job_id, stage_id)
+            if plan is None:
+                continue
+            unresolved = find_unresolved_shuffles(plan)
+            locations: Dict[int, List[ShuffleLocation]] = {}
+            runnable = True
+            for u in unresolved:
+                upstream = by_stage.get((job_id, u.stage_id), [])
+                if not upstream or any(
+                    t.WhichOneof("status") != "completed" for t in upstream
+                ):
+                    runnable = False
+                    break
+                locs = []
+                for t in sorted(upstream, key=lambda t: t.partition_id.partition_id):
+                    meta = self.get_executor_metadata(t.completed.executor_id)
+                    host, port = (meta.host, meta.port) if meta else ("", 0)
+                    locs.append(
+                        ShuffleLocation(
+                            t.completed.executor_id, host, port, t.completed.path
+                        )
+                    )
+                locations[u.stage_id] = locs
+            if not runnable:
+                continue
+            bound = remove_unresolved_shuffles(plan, locations) if unresolved else plan
+            # mark running
+            running = pb.TaskStatus()
+            running.partition_id.CopyFrom(task.partition_id)
+            running.running.executor_id = executor_id
+            self.save_task_status(running)
+            return running, bound
+        return None
+
+    # -- job status fold ------------------------------------------------------
+    def synchronize_job_status(self, job_id: str) -> None:
+        """Fold task statuses into the job status (ref state/mod.rs:267-358)."""
+        current = self.get_job_metadata(job_id)
+        if current is not None and current.WhichOneof("status") == "queued":
+            # still being planned; tasks not yet created
+            return
+        tasks = self.get_job_tasks(job_id)
+        if not tasks:
+            return
+        status = pb.JobStatus()
+        any_failed = None
+        all_completed = True
+        for t in tasks:
+            w = t.WhichOneof("status")
+            if w == "failed":
+                any_failed = t.failed.error
+                break
+            if w != "completed":
+                all_completed = False
+        if any_failed is not None:
+            status.failed.error = any_failed
+        elif all_completed:
+            final_stage = max(t.partition_id.stage_id for t in tasks)
+            for t in sorted(tasks, key=lambda t: t.partition_id.partition_id):
+                if t.partition_id.stage_id != final_stage:
+                    continue
+                pl = status.completed.partition_location.add()
+                pl.partition_id.CopyFrom(t.partition_id)
+                meta = self.get_executor_metadata(t.completed.executor_id)
+                if meta is not None:
+                    pl.executor_meta.CopyFrom(meta)
+                pl.path = t.completed.path
+                pl.partition_stats.CopyFrom(t.completed.stats)
+        else:
+            status.running.SetInParent()
+        self.save_job_metadata(job_id, status)

@@ -1,0 +1,90 @@
+"""Benchmark: TPC-H q1 end-to-end through the engine, TPU backend vs host
+Arrow backend on the same machine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": rows/s on the device backend,
+   "unit": "rows/s/chip", "vs_baseline": speedup over the host backend}
+
+Reference baseline context: the reference publishes no numbers
+(BASELINE.md); the denominator here is this repo's own host Arrow path —
+the same role the reference's Rust CPU executor plays in BASELINE.json's
+target ("N x the CPU executor's rows/sec").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+SF = float(os.environ.get("BENCH_SF", "1"))
+DATA = REPO / ".bench_cache" / f"tpch_sf{SF}"
+QUERY = (REPO / "benchmarks" / "tpch" / "queries" / "q1.sql").read_text()
+BATCH = "16777216"
+
+
+def ensure_data() -> None:
+    if (DATA / "lineitem").exists():
+        return
+    from benchmarks.tpch.datagen import generate
+
+    DATA.parent.mkdir(exist_ok=True)
+    generate(str(DATA), sf=SF, parts=1)
+
+
+def run_once(backend: str) -> float:
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+    from benchmarks.tpch.datagen import register_all
+
+    ctx = ExecutionContext(
+        BallistaConfig(
+            {
+                "ballista.executor.backend": backend,
+                "ballista.batch.size": BATCH,
+            }
+        )
+    )
+    register_all(ctx, str(DATA))
+    t0 = time.perf_counter()
+    out = ctx.sql(QUERY).collect()
+    dt = time.perf_counter() - t0
+    assert out.num_rows >= 1
+    return dt
+
+
+def main() -> None:
+    ensure_data()
+    import pyarrow.parquet as pq
+
+    rows = pq.read_metadata(
+        sorted((DATA / "lineitem").glob("*.parquet"))[0]
+    ).num_rows * len(list((DATA / "lineitem").glob("*.parquet")))
+
+    # warmup (compile) then measure best-of-2 for the device path
+    run_once("tpu")
+    tpu_dt = min(run_once("tpu"), run_once("tpu"))
+    cpu_dt = run_once("cpu")
+    cpu_dt = min(cpu_dt, run_once("cpu"))
+
+    value = rows / tpu_dt
+    baseline = rows / cpu_dt
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_q1_sf{SF}_rows_per_sec",
+                "value": round(value, 1),
+                "unit": "rows/s/chip",
+                "vs_baseline": round(value / baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

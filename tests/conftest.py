@@ -7,12 +7,19 @@ multi-chip sharding without TPU pods. Must run before jax is imported.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must happen before jax initializes a backend. The axon sitecustomize
+# pre-registers the TPU plugin, so the env var alone is not enough — the
+# config update below (after import) forces CPU for the test session.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 import sys

@@ -1,0 +1,265 @@
+"""Serde roundtrip tests — the reference's largest test surface
+(rust/core/src/serde/logical_plan/mod.rs roundtrip_test! macro cases and
+physical_plan/mod.rs). Equality by display-string comparison, like the
+reference's format!-based assertion (mod.rs:43-46)."""
+
+import datetime
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.datasource import MemoryTableSource
+from ballista_tpu.logical import expr as lx
+from ballista_tpu.logical import plan as lp
+from ballista_tpu.logical.builder import LogicalPlanBuilder
+from ballista_tpu.serde.logical import (
+    expr_from_proto,
+    expr_to_proto,
+    plan_from_proto,
+    plan_to_proto,
+)
+from ballista_tpu.logical.expr import col, functions as F, lit
+
+SCHEMA = pa.schema(
+    [
+        pa.field("a", pa.int64()),
+        pa.field("b", pa.float64()),
+        pa.field("c", pa.string()),
+        pa.field("d", pa.date32()),
+    ]
+)
+
+
+def roundtrip_expr(e: lx.Expr):
+    msg = expr_to_proto(e)
+    data = msg.SerializeToString()
+    from ballista_tpu.proto import ballista_pb2 as pb
+
+    decoded = pb.LogicalExprNode()
+    decoded.ParseFromString(data)
+    e2 = expr_from_proto(decoded)
+    assert str(e2) == str(e), f"{e2} != {e}"
+    return e2
+
+
+EXPR_CASES = [
+    col("a"),
+    lx.Column("x", "t"),
+    lit(42),
+    lit(3.5),
+    lit("hello"),
+    lit(True),
+    lit(None),
+    lx.Literal(datetime.date(1994, 1, 1), pa.date32()),
+    lx.Literal(datetime.datetime(1994, 1, 1, 12, 30), pa.timestamp("us")),
+    col("a") + lit(1),
+    col("a") - lit(1),
+    (col("a") * lit(2)) / col("b"),
+    col("a") == lit(5),
+    (col("a") > lit(1)) & (col("b") < lit(2.0)),
+    (col("a") >= lit(1)) | (col("b") <= lit(2.0)),
+    ~(col("a") != lit(0)),
+    -col("b"),
+    col("c").like("%foo%"),
+    col("c").not_like("bar%"),
+    lx.Like(col("c"), lit("x_%"), True, "\\"),
+    col("a").is_null(),
+    col("a").is_not_null(),
+    col("a").between(lit(1), lit(10)),
+    col("a").between(lit(1), lit(10), negated=True),
+    col("c").isin(["x", "y"]),
+    col("a").isin([1, 2, 3], negated=True),
+    lx.Case(None, [(col("a") > lit(0), lit("pos"))], lit("neg")),
+    lx.Case(col("a"), [(lit(1), lit("one")), (lit(2), lit("two"))], None),
+    col("a").cast(pa.float32()),
+    lx.TryCast(col("c"), pa.int64()),
+    lx.ScalarFunction("sqrt", [col("b")]),
+    lx.ScalarFunction("substring", [col("c"), lit(1), lit(2)]),
+    lx.ScalarFunction("extract", [lit("year"), col("d")]),
+    F.sum(col("a")),
+    F.avg(col("b")),
+    F.min(col("a")),
+    F.max(col("a")),
+    F.count(col("c")),
+    F.count(distinct=True),
+    lx.AggregateExpr("count", col("c"), distinct=True),
+    col("a").sort(ascending=False, nulls_first=True),
+    lx.Wildcard(),
+]
+
+
+@pytest.mark.parametrize("e", EXPR_CASES, ids=lambda e: str(e)[:40])
+def test_expr_roundtrip(e):
+    roundtrip_expr(e)
+
+
+def _scan() -> LogicalPlanBuilder:
+    table = pa.table(
+        {
+            "a": pa.array([1, 2, 3], type=pa.int64()),
+            "b": pa.array([1.0, 2.0, 3.0]),
+            "c": pa.array(["x", "y", "z"]),
+            "d": pa.array([datetime.date(2020, 1, 1)] * 3),
+        }
+    )
+    return LogicalPlanBuilder.scan("t", MemoryTableSource.from_table(table, 2))
+
+
+def roundtrip_plan(plan: lp.LogicalPlan):
+    msg = plan_to_proto(plan)
+    decoded_bytes = msg.SerializeToString()
+    from ballista_tpu.proto import ballista_pb2 as pb
+
+    decoded = pb.LogicalPlanNode()
+    decoded.ParseFromString(decoded_bytes)
+    p2 = plan_from_proto(decoded)
+    assert str(p2) == str(plan)
+    assert p2.schema().equals(plan.schema())
+    return p2
+
+
+def test_roundtrip_scan_projection_filter():
+    plan = (
+        _scan()
+        .filter(col("a") > lit(1))
+        .project([col("a"), (col("b") * lit(2.0)).alias("b2")])
+        .build()
+    )
+    roundtrip_plan(plan)
+
+
+def test_roundtrip_aggregate_sort_limit():
+    plan = (
+        _scan()
+        .aggregate([col("c")], [F.sum(col("a")).alias("s"), F.avg(col("b")).alias("m")])
+        .sort([col("s").sort(ascending=False)])
+        .limit(5)
+        .build()
+    )
+    roundtrip_plan(plan)
+
+
+def test_roundtrip_joins():
+    left = _scan().alias("l")
+    right = _scan().alias("r")
+    plan = left.join(
+        right,
+        [(lx.Column("a", "l"), lx.Column("a", "r"))],
+        lp.JoinType.INNER,
+    ).build()
+    roundtrip_plan(plan)
+
+    semi = left.join(
+        _scan().alias("r2"),
+        [(lx.Column("a", "l"), lx.Column("a", "r2"))],
+        lp.JoinType.SEMI,
+        filter=lx.Column("b", "l") > lit(1.0),
+    ).build()
+    roundtrip_plan(semi)
+
+
+def test_roundtrip_repartition_union_distinct():
+    plan = (
+        _scan()
+        .repartition_hash([col("a")], 4)
+        .distinct()
+        .build()
+    )
+    roundtrip_plan(plan)
+    u = _scan().union([_scan()]).build()
+    roundtrip_plan(u)
+
+
+def test_roundtrip_empty_and_ddl():
+    roundtrip_plan(lp.EmptyRelation(True, pa.schema([pa.field("x", pa.int32())])))
+    roundtrip_plan(
+        lp.CreateExternalTable("t2", "/tmp/x", "csv", True, SCHEMA)
+    )
+
+
+def test_roundtrip_memory_scan_preserves_data():
+    plan = _scan().build()
+    p2 = roundtrip_plan(plan)
+    # memory partitions carry actual rows over the wire (IPC)
+    assert p2.source.num_partitions() == 2
+    total = sum(b.num_rows for part in p2.source.partitions for b in part)
+    assert total == 3
+
+
+class TestPhysicalRoundtrip:
+    def _physical(self, df_builder):
+        from ballista_tpu.engine import ExecutionContext
+
+        ctx = ExecutionContext()
+        return ctx.create_physical_plan(df_builder.build())
+
+    def roundtrip(self, plan):
+        from ballista_tpu.proto import ballista_pb2 as pb
+        from ballista_tpu.serde.physical import (
+            phys_plan_from_proto,
+            phys_plan_to_proto,
+        )
+
+        msg = phys_plan_to_proto(plan)
+        decoded = pb.PhysicalPlanNode()
+        decoded.ParseFromString(msg.SerializeToString())
+        p2 = phys_plan_from_proto(decoded)
+        if "mode=final" not in str(plan):
+            # FINAL aggregates deserialize with positional placeholder
+            # expressions (they never re-evaluate inputs), so display
+            # equality is only guaranteed elsewhere
+            assert str(p2) == str(plan)
+        assert p2.schema().equals(plan.schema())
+        return p2
+
+    def test_filter_project(self):
+        plan = self._physical(
+            _scan().filter(col("a") > lit(1)).project([col("a"), col("c")])
+        )
+        self.roundtrip(plan)
+
+    def test_aggregate_two_phase(self):
+        plan = self._physical(
+            _scan().aggregate([col("c")], [F.sum(col("a")).alias("s"),
+                                           F.avg(col("b")).alias("m"),
+                                           F.count(col("a")).alias("n")])
+        )
+        p2 = self.roundtrip(plan)
+        # execution equivalence after roundtrip
+        from ballista_tpu.physical.plan import TaskContext, collect_all
+
+        t1 = collect_all(plan, TaskContext()).sort_by("c")
+        t2 = collect_all(p2, TaskContext()).sort_by("c")
+        assert t1.equals(t2)
+
+    def test_join_sort_limit(self):
+        left = _scan().alias("l")
+        right = _scan().alias("r")
+        df = left.join(right, [(lx.Column("a", "l"), lx.Column("a", "r"))]).sort(
+            [lx.Column("a", "l").sort()]
+        ).limit(2)
+        plan = self._physical(df)
+        self.roundtrip(plan)
+
+    def test_shuffle_nodes(self):
+        from ballista_tpu.distributed.stages import (
+            ShuffleLocation,
+            ShuffleReaderExec,
+            ShuffleWriterExec,
+            UnresolvedShuffleExec,
+        )
+        from ballista_tpu.physical.plan import Partitioning
+
+        inner = self._physical(_scan())
+        w = ShuffleWriterExec(
+            "job1", 3, inner, Partitioning.hash([__import__("ballista_tpu.physical.expr", fromlist=["ColumnExpr"]).ColumnExpr("a", 0)], 4)
+        )
+        self.roundtrip(w)
+        r = ShuffleReaderExec(
+            [ShuffleLocation("e1", "h", 50051, "/tmp/x")],
+            SCHEMA,
+            4,
+        )
+        self.roundtrip(r)
+        u = UnresolvedShuffleExec(7, SCHEMA, 2)
+        self.roundtrip(u)
