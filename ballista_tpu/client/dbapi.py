@@ -1,0 +1,149 @@
+"""DB-API 2.0 (PEP 249) interface — the Python-native counterpart of the
+reference's JDBC driver (reference jvm/jdbc/: jdbc:arrow:// over Flight).
+
+    import ballista_tpu.client.dbapi as db
+    conn = db.connect(host="localhost", port=50050)
+    cur = conn.cursor()
+    cur.execute("select l_returnflag, count(*) from lineitem group by 1")
+    print(cur.fetchall())
+
+connect(local=True) runs against an in-process engine instead of a cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+apilevel = "2.0"
+threadsafety = 1
+paramstyle = "qmark"
+
+
+class Error(Exception):
+    pass
+
+
+class InterfaceError(Error):
+    pass
+
+
+class DatabaseError(Error):
+    pass
+
+
+def connect(host: str = "localhost", port: int = 50050, local: bool = False,
+            settings=None) -> "Connection":
+    return Connection(host, port, local, settings)
+
+
+class Connection:
+    def __init__(self, host: str, port: int, local: bool, settings) -> None:
+        if local:
+            from ballista_tpu.config import BallistaConfig
+            from ballista_tpu.engine import ExecutionContext
+
+            self._ctx = ExecutionContext(BallistaConfig(settings))
+        else:
+            from ballista_tpu.client import BallistaContext
+
+            self._ctx = BallistaContext(host, port, settings)
+        self._closed = False
+
+    @property
+    def context(self):
+        """The underlying context (for table registration)."""
+        return self._ctx
+
+    def cursor(self) -> "Cursor":
+        if self._closed:
+            raise InterfaceError("connection is closed")
+        return Cursor(self)
+
+    def commit(self) -> None:
+        pass  # queries are read-only
+
+    def rollback(self) -> None:
+        pass
+
+    def close(self) -> None:
+        self._closed = True
+        close = getattr(self._ctx, "close", None)
+        if close:
+            close()
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, conn: Connection) -> None:
+        self._conn = conn
+        self._rows: Optional[List[Tuple]] = None
+        self._pos = 0
+        self.description: Optional[List[Tuple]] = None
+        self.rowcount = -1
+
+    def execute(self, operation: str, parameters: Optional[Sequence[Any]] = None) -> "Cursor":
+        if parameters:
+            for p in parameters:
+                operation = operation.replace("?", _quote(p), 1)
+        try:
+            table = self._conn._ctx.sql(operation).collect()
+        except Exception as e:
+            raise DatabaseError(str(e)) from e
+        self.description = [
+            (f.name, str(f.type), None, None, None, None, f.nullable)
+            for f in table.schema
+        ]
+        cols = [c.to_pylist() for c in table.columns]
+        self._rows = list(zip(*cols)) if cols else [()] * table.num_rows
+        self.rowcount = table.num_rows
+        self._pos = 0
+        return self
+
+    def executemany(self, operation: str, seq_of_parameters) -> None:
+        for p in seq_of_parameters:
+            self.execute(operation, p)
+
+    def fetchone(self) -> Optional[Tuple]:
+        if self._rows is None:
+            raise InterfaceError("no query executed")
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> List[Tuple]:
+        size = size or self.arraysize
+        out = []
+        for _ in range(size):
+            row = self.fetchone()
+            if row is None:
+                break
+            out.append(row)
+        return out
+
+    def fetchall(self) -> List[Tuple]:
+        if self._rows is None:
+            raise InterfaceError("no query executed")
+        out = list(self._rows[self._pos:])
+        self._pos = len(self._rows)
+        return out
+
+    def close(self) -> None:
+        self._rows = None
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+
+def _quote(v: Any) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    return str(v)

@@ -22,6 +22,7 @@ BALLISTA_MESH_SHAPE = "ballista.tpu.mesh"  # e.g. "data:8" or "data:4,model:2"
 BALLISTA_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
 BALLISTA_DEVICE_CACHE = "ballista.tpu.device_cache"  # keep encoded columns resident in HBM
 BALLISTA_SCAN_CACHE = "ballista.scan.cache"  # host-side decoded-table cache (parquet)
+BALLISTA_SCAN_CACHE_CAP = "ballista.scan.cache_cap_bytes"
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -33,6 +34,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_SHUFFLE_PARTITIONS: "16",
     BALLISTA_DEVICE_CACHE: "true",
     BALLISTA_SCAN_CACHE: "true",
+    BALLISTA_SCAN_CACHE_CAP: str(4 << 30),
 }
 
 
@@ -73,6 +75,9 @@ class BallistaConfig(Mapping[str, str]):
 
     def scan_cache(self) -> bool:
         return self._settings[BALLISTA_SCAN_CACHE].lower() in ("1", "true", "yes")
+
+    def scan_cache_cap(self) -> int:
+        return int(self._settings[BALLISTA_SCAN_CACHE_CAP])
 
     def mesh_shape(self) -> Dict[str, int]:
         """Parse "data:4,model:2" into {"data": 4, "model": 2}."""

@@ -129,6 +129,10 @@ class ShuffleWriterExec(ExecutionPlan):
             sink = pa.OSFile(os.path.join(base, f"{m}.arrow"), "wb")
             writers.append((sink, pa.ipc.new_file(sink, schema)))
         try:
+            import numpy as np
+
+            from ballista_tpu.physical.repartition import split_by_partition
+
             for batch in self.input.execute(partition, ctx):
                 if pscheme.scheme == "hash":
                     keys = [
@@ -137,13 +141,8 @@ class ShuffleWriterExec(ExecutionPlan):
                     ]
                     ids = hash_rows(keys, n_out)
                 else:
-                    import numpy as np
-
                     ids = np.arange(batch.num_rows, dtype=np.int64) % n_out
-                import numpy as np
-
-                for m in range(n_out):
-                    piece = batch.filter(pa.array(ids == m))
+                for m, piece in enumerate(split_by_partition(batch, ids, n_out)):
                     if piece.num_rows:
                         writers[m][1].write_batch(piece)
                         total.num_rows += piece.num_rows

@@ -97,9 +97,13 @@ class ExecutionContext:
         return planner.create_physical_plan(self.optimize(plan))
 
     def collect(self, plan: lp.LogicalPlan) -> pa.Table:
-        physical = self.create_physical_plan(plan)
+        from ballista_tpu.utils.tracing import span
+
+        with span("plan"):
+            physical = self.create_physical_plan(plan)
         ctx = TaskContext(config=self.config)
-        return collect_all(physical, ctx)
+        with span("execute"):
+            return collect_all(physical, ctx)
 
 
 class DataFrame:

@@ -1,0 +1,62 @@
+"""Executor daemon: python -m ballista_tpu.executor [--local ...]
+
+(ref rust/executor/src/main.rs: config parse; --local spins an in-process
+scheduler first, main.rs:101-138; start Flight server; run the poll loop.)
+"""
+
+from __future__ import annotations
+
+import logging
+import tempfile
+import time
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.daemon_config import EXECUTOR_SPEC, load_config
+from ballista_tpu.executor.runtime import BallistaExecutor
+from ballista_tpu.scheduler.kv import SqliteBackend
+from ballista_tpu.scheduler.server import SchedulerServer, serve
+
+
+def main() -> None:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    log = logging.getLogger("ballista.executor")
+    cfg = load_config(
+        EXECUTOR_SPEC,
+        "BALLISTA_EXECUTOR_",
+        "/etc/ballista/executor.toml",
+        prog="ballista-executor",
+    )
+    scheduler_host, scheduler_port = cfg["scheduler_host"], cfg["scheduler_port"]
+    if cfg["local"]:
+        kv = SqliteBackend(tempfile.mktemp(prefix="ballista-local-", suffix=".db"))
+        impl = SchedulerServer(kv, namespace=cfg["namespace"])
+        serve(impl, "127.0.0.1", cfg["scheduler_port"])
+        scheduler_host = "127.0.0.1"
+        log.info("in-process scheduler on port %s", scheduler_port)
+
+    executor = BallistaExecutor(
+        scheduler_host,
+        scheduler_port,
+        external_host=cfg["external_host"],
+        port=cfg["port"],
+        work_dir=cfg["work_dir"] or None,
+        concurrent_tasks=cfg["concurrent_tasks"],
+        config=BallistaConfig({"ballista.executor.backend": cfg["backend"]}),
+    )
+    executor.start()
+    log.info(
+        "Ballista-TPU executor up (id=%s, flight=%s:%s, backend=%s)",
+        executor.id, cfg["external_host"], executor.port, cfg["backend"],
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        executor.stop()
+
+
+if __name__ == "__main__":
+    main()

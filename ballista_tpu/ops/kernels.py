@@ -24,7 +24,10 @@ from ballista_tpu.ops.runtime import (
 )
 
 _stage_cache: Dict[str, object] = {}
-_filter_cache: Dict[int, Tuple[object, object]] = {}
+# pins each cached stage's table source so its id() (part of the cache key
+# for memory scans) can never be recycled by a different object
+_stage_cache_pins: Dict[str, object] = {}
+_filter_cache: Dict[tuple, object] = {}
 _cache_configured = False
 
 
@@ -82,8 +85,10 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
             stage = FusedAggregateStage(exec_node)
         except UnsupportedOnDevice:
             _stage_cache[key] = False
+            _stage_cache_pins[key] = node.source if hasattr(node, "source") else None
             return None
         _stage_cache[key] = stage
+        _stage_cache_pins[key] = node.source if hasattr(node, "source") else None
     if stage is False:
         return None
     try:
@@ -94,7 +99,9 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
 
 
 def _compile_predicate(predicate, schema: pa.Schema):
-    key = id(predicate)
+    # structural key (an id() key could be recycled after GC and serve a
+    # stale compiled predicate)
+    key = (str(predicate), tuple(schema.names), tuple(str(t) for t in schema.types))
     hit = _filter_cache.get(key)
     if hit is not None:
         return hit

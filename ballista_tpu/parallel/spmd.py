@@ -22,7 +22,7 @@ import functools
 from typing import Callable, List, Sequence, Tuple
 
 
-def build_psum_aggregate(mesh, num_groups: int, n_values: int,
+def build_psum_aggregate(mesh, num_groups: int,
                          mask_fn: Callable, value_fns: Sequence[Callable]):
     """Aggregation with replicated output: each shard computes masked
     per-group partial sums from its rows; lax.psum merges over the mesh.
@@ -141,4 +141,4 @@ def build_q1_style_step(mesh, num_groups: int, cutoff_days: int):
         lambda qty, price, disc, tax, ship: price * (1.0 - disc) * (1.0 + tax),
         lambda qty, price, disc, tax, ship: disc,
     ]
-    return build_psum_aggregate(mesh, num_groups, len(value_fns), mask_fn, value_fns)
+    return build_psum_aggregate(mesh, num_groups, mask_fn, value_fns)

@@ -34,7 +34,13 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
 
 
 def hash_rows(arrays: List[pa.Array], num_partitions: int) -> np.ndarray:
-    """Map each row to a partition id by hashing key columns."""
+    """Map each row to a partition id by hashing key columns. Uses the C++
+    kernel when available (bit-identical scheme), numpy otherwise."""
+    from ballista_tpu.native import native_hash_rows
+
+    native = native_hash_rows(arrays, num_partitions)
+    if native is not None:
+        return native.astype(np.int64)
     n = len(arrays[0])
     acc = np.zeros(n, dtype=np.uint64)
     for arr in arrays:
@@ -43,6 +49,12 @@ def hash_rows(arrays: List[pa.Array], num_partitions: int) -> np.ndarray:
             a = a.cast(pa.int32())
         elif pa.types.is_date64(a.type) or pa.types.is_timestamp(a.type):
             a = a.cast(pa.int64())
+        # NULL keys hash deterministically to 0 (NaN->int is platform-
+        # dependent; a mixed cluster must agree on NULL's partition)
+        null_mask = None
+        if a.null_count:
+            null_mask = pc.is_null(a).to_numpy(zero_copy_only=False)
+            a = pc.fill_null(a, pa.scalar(0, type=a.type) if not pa.types.is_string(a.type) else "")
         if pa.types.is_integer(a.type) or pa.types.is_boolean(a.type):
             vals = pc.cast(a, pa.int64()).to_numpy(zero_copy_only=False).astype(np.int64)
             h = _splitmix64(vals.view(np.uint64) if vals.dtype == np.int64 else vals.astype(np.uint64))
@@ -61,8 +73,33 @@ def hash_rows(arrays: List[pa.Array], num_partitions: int) -> np.ndarray:
                 for b in str(v).encode():
                     acc2 = np.uint64((int(acc2) ^ b) * 0x100000001B3 & 0xFFFFFFFFFFFFFFFF)
                 h[i] = acc2
+        if null_mask is not None:
+            h = np.where(null_mask, np.uint64(0), h)
         acc = _splitmix64(acc ^ h)
     return (acc % np.uint64(num_partitions)).astype(np.int64)
+
+
+def split_by_partition(
+    batch: pa.RecordBatch, part_ids: np.ndarray, n_out: int
+) -> List[pa.RecordBatch]:
+    """One-pass split: counting-sort row indices by partition (C++ kernel
+    when available), then a single take + per-partition zero-copy slices —
+    O(n + P) instead of P full-batch filters."""
+    from ballista_tpu.native import native_partition_indices
+
+    res = native_partition_indices(np.asarray(part_ids, dtype=np.int32), n_out)
+    if res is None:
+        order = np.argsort(part_ids, kind="stable")
+        sorted_ids = np.asarray(part_ids)[order]
+        offsets = np.searchsorted(sorted_ids, np.arange(n_out + 1))
+        indices = order
+    else:
+        indices, offsets = res
+    taken = batch.take(pa.array(indices))
+    return [
+        taken.slice(int(offsets[m]), int(offsets[m + 1] - offsets[m]))
+        for m in range(n_out)
+    ]
 
 
 class RepartitionExec(ExecutionPlan):
@@ -93,16 +130,9 @@ class RepartitionExec(ExecutionPlan):
                 for e in self.partitioning.exprs
             ]
             part_ids = hash_rows(keys, n_out)
-            return [
-                batch.filter(pa.array(part_ids == p)) for p in range(n_out)
-            ]
-        # round-robin: contiguous row striping
-        out = []
-        rows = np.arange(batch.num_rows, dtype=np.int64)
-        ids = rows % n_out
-        for p in range(n_out):
-            out.append(batch.filter(pa.array(ids == p)))
-        return out
+        else:
+            part_ids = np.arange(batch.num_rows, dtype=np.int64) % n_out
+        return split_by_partition(batch, part_ids, n_out)
 
     def _materialize(self, ctx: TaskContext) -> List[pa.Table]:
         with self._lock:

@@ -20,7 +20,6 @@ import threading as _threading
 
 _TABLE_CACHE: Dict[tuple, pa.Table] = {}
 _TABLE_CACHE_BYTES = [0]
-_TABLE_CACHE_CAP = 16 << 30
 _TABLE_CACHE_MU = _threading.Lock()
 
 
@@ -29,9 +28,9 @@ def _cache_get(key: tuple) -> Optional[pa.Table]:
         return _TABLE_CACHE.get(key)
 
 
-def _maybe_cache(key: tuple, table: pa.Table) -> None:
+def _maybe_cache(key: tuple, table: pa.Table, cap: int) -> None:
     nbytes = table.nbytes
-    if nbytes > _TABLE_CACHE_CAP:
+    if nbytes > cap:
         return
     with _TABLE_CACHE_MU:
         # drop stale entries for the same (path, cols) with older mtimes
@@ -40,7 +39,7 @@ def _maybe_cache(key: tuple, table: pa.Table) -> None:
             _TABLE_CACHE_BYTES[0] -= _TABLE_CACHE[k].nbytes
             del _TABLE_CACHE[k]
         # FIFO eviction to fit
-        while _TABLE_CACHE_BYTES[0] + nbytes > _TABLE_CACHE_CAP and _TABLE_CACHE:
+        while _TABLE_CACHE_BYTES[0] + nbytes > cap and _TABLE_CACHE:
             k = next(iter(_TABLE_CACHE))
             _TABLE_CACHE_BYTES[0] -= _TABLE_CACHE[k].nbytes
             del _TABLE_CACHE[k]
@@ -115,12 +114,13 @@ class ParquetScanExec(ExecutionPlan):
         # decoded-table cache: repeated queries skip parquet decode (the
         # host-side analog of the device column cache). Files too large to
         # ever fit stream instead of materializing.
-        if ctx.config.scan_cache() and os.path.getsize(path) * 4 <= _TABLE_CACHE_CAP:
+        cap = ctx.config.scan_cache_cap()
+        if ctx.config.scan_cache() and os.path.getsize(path) * 4 <= cap:
             key = (path, os.path.getmtime(path), tuple(cols) if cols else None)
             table = _cache_get(key)
             if table is None:
                 table = pa.parquet.read_table(path, columns=cols)
-                _maybe_cache(key, table)
+                _maybe_cache(key, table, cap)
             yield from table.to_batches(max_chunksize=ctx.batch_size)
             return
         pf = pa.parquet.ParquetFile(path)

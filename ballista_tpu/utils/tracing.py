@@ -1,0 +1,74 @@
+"""Lightweight tracing spans + optional XLA profiler hook.
+
+The reference only has coarse Instant-based timings around planning and
+per-partition execution (SURVEY §5); this gives named nested spans with a
+queryable log, plus jax.profiler integration for device traces.
+
+    with span("physical_planning"):
+        ...
+    print(report())
+
+Env BALLISTA_TRACE_DIR enables jax.profiler.trace into that directory for
+spans marked device=True (view in TensorBoard / xprof).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+_local = threading.local()
+_all_spans: List[Tuple[str, float, int]] = []  # (path, seconds, depth)
+_mu = threading.Lock()
+
+
+def _stack() -> List[str]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+@contextlib.contextmanager
+def span(name: str, device: bool = False) -> Iterator[None]:
+    stack = _stack()
+    stack.append(name)
+    path = "/".join(stack)
+    trace_dir = os.environ.get("BALLISTA_TRACE_DIR")
+    ctx = contextlib.nullcontext()
+    if device and trace_dir:
+        import jax
+
+        ctx = jax.profiler.trace(trace_dir)
+    t0 = time.perf_counter()
+    try:
+        with ctx:
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        with _mu:
+            _all_spans.append((path, dt, len(stack) - 1))
+        stack.pop()
+
+
+def report(reset: bool = False) -> str:
+    with _mu:
+        lines = [
+            f"{'  ' * depth}{path.split('/')[-1]}: {dt * 1000:.2f} ms"
+            for path, dt, depth in _all_spans
+        ]
+        if reset:
+            _all_spans.clear()
+    return "\n".join(lines)
+
+
+def spans() -> List[Tuple[str, float, int]]:
+    with _mu:
+        return list(_all_spans)
+
+
+def reset() -> None:
+    with _mu:
+        _all_spans.clear()

@@ -1,0 +1,110 @@
+"""Tests for the surrounding tooling: DB-API, TPC-H CLI helpers, config
+precedence, diagrams, tracing."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pyarrow as pa
+import pytest
+
+
+def test_dbapi_local(sales_table):
+    import ballista_tpu.client.dbapi as db
+
+    conn = db.connect(local=True)
+    conn.context.register_record_batches("sales", sales_table)
+    cur = conn.cursor()
+    cur.execute("select region, sum(amount) as s from sales group by region order by s")
+    assert cur.description[0][0] == "region"
+    rows = cur.fetchall()
+    assert rows == [("north", 40.0), ("east", 120.0), ("west", 145.0)]
+    cur.execute("select id from sales where amount > ? order by id", (40,))
+    assert [r[0] for r in cur.fetchall()] == [7, 8, 9]
+    assert cur.fetchone() is None or True  # exhausted
+    cur.execute("select id from sales order by id limit 3")
+    assert cur.fetchone() == (0,)
+    assert cur.fetchmany(2) == [(1,), (2,)]
+    conn.close()
+    with pytest.raises(db.InterfaceError):
+        conn.cursor()
+
+
+def test_dbapi_error():
+    import ballista_tpu.client.dbapi as db
+
+    conn = db.connect(local=True)
+    with pytest.raises(db.DatabaseError):
+        conn.cursor().execute("select * from nonexistent")
+
+
+def test_daemon_config_precedence(tmp_path, monkeypatch):
+    from ballista_tpu.daemon_config import SCHEDULER_SPEC, load_config
+
+    # default
+    cfg = load_config(SCHEDULER_SPEC, "BT_TEST_", "", argv=[])
+    assert cfg["port"] == 50050
+    # env beats default
+    monkeypatch.setenv("BT_TEST_PORT", "60000")
+    cfg = load_config(SCHEDULER_SPEC, "BT_TEST_", "", argv=[])
+    assert cfg["port"] == 60000
+    # file beats env
+    f = tmp_path / "cfg.toml"
+    f.write_text('port = 60001\nnamespace = "ns-file"\n')
+    cfg = load_config(SCHEDULER_SPEC, "BT_TEST_", "", argv=["--config-file", str(f)])
+    assert cfg["port"] == 60001 and cfg["namespace"] == "ns-file"
+    # CLI beats file
+    cfg = load_config(
+        SCHEDULER_SPEC, "BT_TEST_", "", argv=["--config-file", str(f), "--port", "60002"]
+    )
+    assert cfg["port"] == 60002
+
+
+def test_stage_diagram(sales_table):
+    from ballista_tpu.distributed.planner import DistributedPlanner
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.logical import col, functions as F
+    from ballista_tpu.utils.diagram import plan_diagram, produce_diagram
+
+    ctx = ExecutionContext()
+    ctx.register_record_batches("sales", sales_table, n_partitions=2)
+    df = ctx.table("sales").aggregate([col("region")], [F.sum(col("amount")).alias("s")])
+    physical = ctx.create_physical_plan(df.logical_plan())
+    stages = DistributedPlanner().plan_query_stages("jobx", physical)
+    dot = produce_diagram(stages)
+    assert dot.startswith("digraph G {") and "shuffle" in dot
+    assert dot.count("subgraph cluster_") == len(stages)
+    single = plan_diagram(physical)
+    assert "HashAggregateExec" in single
+
+
+def test_tracing_spans(sales_table):
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.utils import tracing
+
+    tracing.reset()
+    ctx = ExecutionContext()
+    ctx.register_record_batches("sales", sales_table)
+    ctx.sql("select count(*) as n from sales").collect()
+    paths = [p for p, _dt, _d in tracing.spans()]
+    assert "plan" in paths and "execute" in paths
+    assert "ms" in tracing.report(reset=True)
+    assert tracing.spans() == []
+
+
+def test_tpch_cli_benchmark(tmp_path):
+    from benchmarks.tpch.datagen import generate
+
+    d = tmp_path / "tpch"
+    generate(str(d), sf=0.001, parts=1)
+    env = dict(os.environ, PYTHONPATH=os.getcwd(), JAX_PLATFORMS="cpu",
+               PALLAS_AXON_POOL_IPS="")
+    out = subprocess.run(
+        [sys.executable, "-m", "benchmarks.tpch.runner", "benchmark",
+         "--path", str(d), "--query", "6", "--iterations", "1"],
+        capture_output=True, text=True, env=env, timeout=180,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    result = json.loads(out.stdout)
+    assert "q6" in result and result["q6"]["rows"] == 1
