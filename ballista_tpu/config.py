@@ -23,6 +23,11 @@ BALLISTA_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
 BALLISTA_DEVICE_CACHE = "ballista.tpu.device_cache"  # keep encoded columns resident in HBM
 BALLISTA_SCAN_CACHE = "ballista.scan.cache"  # host-side decoded-table cache (parquet)
 BALLISTA_SCAN_CACHE_CAP = "ballista.scan.cache_cap_bytes"
+# experimental per-operator device offload (filter/projection masks, PK-FK
+# join). Whole-stage fusion is the default TPU path; per-op offload only pays
+# when host<->device latency is low, so it is opt-in.
+BALLISTA_TPU_PER_OP = "ballista.tpu.per_op_dispatch"
+BALLISTA_TPU_DEVICE_JOIN = "ballista.tpu.device_join"
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -35,6 +40,8 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_DEVICE_CACHE: "true",
     BALLISTA_SCAN_CACHE: "true",
     BALLISTA_SCAN_CACHE_CAP: str(4 << 30),
+    BALLISTA_TPU_PER_OP: "false",
+    BALLISTA_TPU_DEVICE_JOIN: "false",
 }
 
 
@@ -78,6 +85,12 @@ class BallistaConfig(Mapping[str, str]):
 
     def scan_cache_cap(self) -> int:
         return int(self._settings[BALLISTA_SCAN_CACHE_CAP])
+
+    def tpu_per_op(self) -> bool:
+        return self._settings[BALLISTA_TPU_PER_OP].lower() in ("1", "true", "yes")
+
+    def tpu_device_join(self) -> bool:
+        return self._settings[BALLISTA_TPU_DEVICE_JOIN].lower() in ("1", "true", "yes")
 
     def mesh_shape(self) -> Dict[str, int]:
         """Parse "data:4,model:2" into {"data": 4, "model": 2}."""

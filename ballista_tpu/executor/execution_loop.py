@@ -53,6 +53,10 @@ class PollLoop:
         self._finished: "queue.Queue[pb.TaskStatus]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # shuffle-dir GC: the reference never collects work dirs
+        # (SURVEY §5 "Nothing garbage-collects work dirs")
+        self.shuffle_ttl_seconds = 3600.0
+        self._last_gc = time.time()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -71,7 +75,33 @@ class PollLoop:
             except Exception as e:
                 # repeated poll failure only warns (ref execution_loop.rs:70-72)
                 log.warning("poll failed: %s", e)
+            if time.time() - self._last_gc > 60:
+                self._last_gc = time.time()
+                try:
+                    self.gc_work_dir()
+                except Exception as e:
+                    log.warning("work-dir GC failed: %s", e)
             self._stop.wait(POLL_INTERVAL_SECS)
+
+    def gc_work_dir(self) -> int:
+        """Delete shuffle dirs for jobs idle longer than shuffle_ttl_seconds."""
+        import shutil
+
+        removed = 0
+        cutoff = time.time() - self.shuffle_ttl_seconds
+        if not os.path.isdir(self.work_dir):
+            return 0
+        for job_dir in os.listdir(self.work_dir):
+            path = os.path.join(self.work_dir, job_dir)
+            try:
+                if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
+                    shutil.rmtree(path, ignore_errors=True)
+                    removed += 1
+            except OSError:
+                continue
+        if removed:
+            log.info("gc: removed %d expired job dirs", removed)
+        return removed
 
     # ------------------------------------------------------------------
     def _drain_statuses(self):

@@ -699,6 +699,63 @@ class AggregateExpr(Expr):
         return f"{self.fn.upper()}({d}{self.expr})"
 
 
+WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank") + AGGREGATE_FUNCTIONS
+
+
+class WindowExpr(Expr):
+    """fn(...) OVER (PARTITION BY ... ORDER BY ...). Frame is always the
+    whole partition (unbounded) — the common analytic surface."""
+
+    def __init__(
+        self,
+        fn: str,
+        arg: Optional["Expr"],
+        partition_by: List["Expr"],
+        order_by: List["SortExpr"],
+    ) -> None:
+        fn = fn.lower()
+        if fn not in WINDOW_FUNCTIONS:
+            raise PlanError(f"unknown window function {fn!r}")
+        self.fn = fn
+        self.arg = arg
+        self.partition_by = partition_by
+        self.order_by = order_by
+
+    def data_type(self, schema: pa.Schema) -> pa.DataType:
+        if self.fn in ("row_number", "rank", "dense_rank", "count"):
+            return pa.int64()
+        if self.fn == "avg":
+            return pa.float64()
+        assert self.arg is not None
+        inner = self.arg.data_type(schema)
+        if self.fn == "sum":
+            if pa.types.is_integer(inner):
+                return pa.int64()
+            if pa.types.is_floating(inner) or pa.types.is_decimal(inner):
+                return pa.float64()
+        return inner
+
+    def children(self) -> List["Expr"]:
+        out: List[Expr] = []
+        if self.arg is not None:
+            out.append(self.arg)
+        out.extend(self.partition_by)
+        out.extend(self.order_by)
+        return out
+
+    def output_name(self) -> str:
+        return str(self)
+
+    def __str__(self) -> str:
+        arg = str(self.arg) if self.arg is not None else ""
+        parts = []
+        if self.partition_by:
+            parts.append("PARTITION BY " + ", ".join(str(e) for e in self.partition_by))
+        if self.order_by:
+            parts.append("ORDER BY " + ", ".join(str(e) for e in self.order_by))
+        return f"{self.fn.upper()}({arg}) OVER ({' '.join(parts)})"
+
+
 class SortExpr(Expr):
     """Sort key wrapper — only valid inside Sort/TopK nodes (proto sort node)."""
 

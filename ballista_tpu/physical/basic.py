@@ -47,7 +47,7 @@ class ProjectionExec(ExecutionPlan):
         return ProjectionExec(children[0], self.exprs)
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
-        use_tpu = ctx.backend == "tpu"
+        use_tpu = ctx.backend == "tpu" and ctx.config.tpu_per_op()
         if use_tpu:
             from ballista_tpu.ops.dispatch import tpu_project
         for batch in self.input.execute(partition, ctx):
@@ -86,7 +86,7 @@ class FilterExec(ExecutionPlan):
         return FilterExec(children[0], self.predicate)
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
-        use_tpu = ctx.backend == "tpu"
+        use_tpu = ctx.backend == "tpu" and ctx.config.tpu_per_op()
         if use_tpu:
             from ballista_tpu.ops.dispatch import tpu_filter
         for batch in self.input.execute(partition, ctx):

@@ -98,6 +98,21 @@ class HashJoinExec(ExecutionPlan):
 
         build = self._collect_build(self.left, ctx)
         probe = collect_partition(self.right, partition, ctx)
+        if (self.join_type == JoinType.INNER and ctx.backend == "tpu"
+                and ctx.config.tpu_device_join()):
+            # device PK-FK join: sorted binary search on TPU; declines (None)
+            # on duplicate build keys and falls through to the host join
+            from ballista_tpu.ops.join import try_device_inner_join
+
+            res = try_device_inner_join(build, probe, left_keys, right_keys)
+            if res is not None:
+                left_idx, right_idx = res
+                left_out = take_table(build, left_idx)
+                right_out = take_table(probe, right_idx)
+                cols = list(left_out.columns) + list(right_out.columns)
+                out = pa.table(cols, schema=self._schema)
+                yield from batch_table(out, ctx.batch_size)
+                return
         bcodes, pcodes = combined_key_codes(
             [build.column(k) for k in left_keys],
             [probe.column(k) for k in right_keys],

@@ -127,7 +127,39 @@ class PhysicalPlanner:
             return ProjectionExec(input, exprs)
         if isinstance(plan, lp.Union):
             return UnionExec([self._plan(c) for c in plan.inputs])
+        if isinstance(plan, lp.Window):
+            return self._plan_window(plan)
         raise PlanError(f"no physical plan for {type(plan).__name__}")
+
+    def _plan_window(self, plan: lp.LogicalPlan) -> ExecutionPlan:
+        from ballista_tpu.physical.window import WindowExec, WindowFuncDesc
+
+        input = self._plan(plan.input)
+        if input.output_partitioning().partition_count() > 1:
+            input = MergeExec(input)
+        in_schema = input.schema()
+        funcs = []
+        for e in plan.window_exprs:
+            w = e.expr if isinstance(e, lx.Alias) else e
+            if not isinstance(w, lx.WindowExpr):
+                raise PlanError(f"window list entry is not a window expr: {e}")
+            arg = (
+                create_physical_expr(w.arg, in_schema) if w.arg is not None else None
+            )
+            funcs.append(
+                WindowFuncDesc(
+                    w.fn,
+                    arg,
+                    [create_physical_expr(p, in_schema) for p in w.partition_by],
+                    [
+                        (create_physical_expr(o.expr, in_schema), o.ascending)
+                        for o in w.order_by
+                    ],
+                    e.output_name(),
+                    e.data_type(in_schema),
+                )
+            )
+        return WindowExec(input, funcs)
 
     # ------------------------------------------------------------------
     def _plan_scan(self, plan: lp.TableScan) -> ExecutionPlan:

@@ -1,0 +1,183 @@
+"""Window function execution.
+
+WindowExec computes analytic functions over full partitions (unbounded
+frame): row_number / rank / dense_rank and the five aggregates. Strategy:
+merge to one partition, sort by (partition keys, order keys), compute
+partition boundaries once, then every function is a vectorized pass —
+cumcounts for ranking, segment-aggregate + broadcast-back for aggregates.
+Output rows come back in sorted order (row order is unspecified unless the
+query adds ORDER BY).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ballista_tpu.errors import PlanError
+from ballista_tpu.physical.expr import PhysicalExpr, _as_array
+from ballista_tpu.physical.plan import (
+    ExecutionPlan,
+    Partitioning,
+    TaskContext,
+    batch_table,
+    collect_partition,
+)
+
+
+class WindowFuncDesc:
+    def __init__(
+        self,
+        fn: str,
+        arg: Optional[PhysicalExpr],
+        partition_by: List[PhysicalExpr],
+        order_by: List[Tuple[PhysicalExpr, bool]],  # (expr, ascending)
+        name: str,
+        dtype: pa.DataType,
+    ) -> None:
+        self.fn = fn
+        self.arg = arg
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.name = name
+        self.dtype = dtype
+
+
+def _codes(arr: pa.Array) -> np.ndarray:
+    d = pc.dictionary_encode(arr)
+    if isinstance(d, pa.ChunkedArray):
+        d = d.combine_chunks()
+    out = d.indices.to_numpy(zero_copy_only=False).astype(np.int64)
+    return out
+
+
+class WindowExec(ExecutionPlan):
+    def __init__(self, input: ExecutionPlan, funcs: List[WindowFuncDesc]) -> None:
+        self.input = input
+        self.funcs = funcs
+        fields = list(input.schema())
+        fields += [pa.field(f.name, f.dtype) for f in funcs]
+        self._schema = pa.schema(fields)
+
+    def schema(self) -> pa.Schema:
+        return self._schema
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(1)
+
+    def children(self) -> List[ExecutionPlan]:
+        return [self.input]
+
+    def with_children(self, children: List[ExecutionPlan]) -> "WindowExec":
+        return WindowExec(children[0], self.funcs)
+
+    def execute(self, partition: int, ctx: TaskContext):
+        assert partition == 0
+        table = collect_partition(self.input, 0, ctx)
+        if table.num_rows == 0:
+            yield from self._schema.empty_table().to_batches()
+            return
+        batch = table.combine_chunks().to_batches()[0]
+        n = batch.num_rows
+        out_cols = list(table.combine_chunks().columns)
+        for f in self.funcs:
+            out_cols.append(self._compute(f, batch, n))
+        yield from batch_table(
+            pa.table(out_cols, schema=self._schema), ctx.batch_size
+        )
+
+    # ------------------------------------------------------------------
+    def _compute(self, f: WindowFuncDesc, batch: pa.RecordBatch, n: int) -> pa.Array:
+        # sort order: partition keys then order keys
+        sort_cols = {}
+        sort_keys = []
+        for i, e in enumerate(f.partition_by):
+            cn = f"__p{i}"
+            sort_cols[cn] = _as_array(e.evaluate(batch), n)
+            sort_keys.append((cn, "ascending"))
+        for i, (e, asc) in enumerate(f.order_by):
+            cn = f"__o{i}"
+            sort_cols[cn] = _as_array(e.evaluate(batch), n)
+            sort_keys.append((cn, "ascending" if asc else "descending"))
+        if sort_cols:
+            key_table = pa.table(sort_cols)
+            order = pc.sort_indices(key_table, sort_keys=sort_keys).to_numpy()
+        else:
+            order = np.arange(n, dtype=np.int64)
+        inv = np.empty(n, dtype=np.int64)
+        inv[order] = np.arange(n, dtype=np.int64)
+
+        # partition ids in sorted order
+        if f.partition_by:
+            pcodes = np.zeros(n, dtype=np.int64)
+            for i in range(len(f.partition_by)):
+                c = _codes(sort_cols[f"__p{i}"])[order]
+                pcodes = pcodes * (int(c.max()) + 1 if len(c) else 1) + c
+            new_part = np.empty(n, dtype=bool)
+            new_part[0] = True
+            new_part[1:] = pcodes[1:] != pcodes[:-1]
+        else:
+            new_part = np.zeros(n, dtype=bool)
+            new_part[0] = True
+        part_id = np.cumsum(new_part) - 1
+        part_start = np.maximum.accumulate(np.where(new_part, np.arange(n), 0))
+
+        if f.fn == "row_number":
+            vals = np.arange(n) - part_start + 1
+            return pa.array(vals[inv], type=pa.int64())
+        if f.fn in ("rank", "dense_rank"):
+            # order-key change detection within a partition
+            changed = np.ones(n, dtype=bool)
+            if f.order_by:
+                ocodes = np.zeros(n, dtype=np.int64)
+                for i in range(len(f.order_by)):
+                    c = _codes(sort_cols[f"__o{i}"])[order]
+                    ocodes = ocodes * (int(c.max()) + 1 if len(c) else 1) + c
+                changed[1:] = (ocodes[1:] != ocodes[:-1]) | new_part[1:]
+            if f.fn == "rank":
+                change_pos = np.maximum.accumulate(np.where(changed, np.arange(n), 0))
+                vals = change_pos - part_start + 1
+            else:
+                dense = np.cumsum(changed)
+                base = np.maximum.accumulate(np.where(new_part, dense, 0))
+                vals = dense - base + 1
+            return pa.array(vals[inv], type=pa.int64())
+
+        # partition aggregates
+        assert f.arg is not None or f.fn == "count"
+        if f.arg is not None:
+            argv = _as_array(f.arg.evaluate(batch), n)
+            av = argv.to_numpy(zero_copy_only=False).astype(np.float64)[order]
+            valid = pc.is_valid(argv).to_numpy(zero_copy_only=False)[order]
+        else:
+            av = np.ones(n, dtype=np.float64)
+            valid = np.ones(n, dtype=bool)
+        nparts = int(part_id[-1]) + 1
+        if f.fn == "count":
+            agg = np.zeros(nparts)
+            np.add.at(agg, part_id, valid.astype(np.float64))
+        elif f.fn in ("sum", "avg"):
+            agg = np.zeros(nparts)
+            np.add.at(agg, part_id, np.where(valid, av, 0.0))
+            if f.fn == "avg":
+                cnt = np.zeros(nparts)
+                np.add.at(cnt, part_id, valid.astype(np.float64))
+                agg = agg / np.maximum(cnt, 1)
+        elif f.fn == "min":
+            agg = np.full(nparts, np.inf)
+            np.minimum.at(agg, part_id, np.where(valid, av, np.inf))
+        elif f.fn == "max":
+            agg = np.full(nparts, -np.inf)
+            np.maximum.at(agg, part_id, np.where(valid, av, -np.inf))
+        else:
+            raise PlanError(f"unsupported window function {f.fn}")
+        vals = agg[part_id][inv]
+        return pc.cast(pa.array(vals), f.dtype)
+
+    def fmt(self) -> str:
+        return "WindowExec: " + ", ".join(
+            f"{f.fn}(...) AS {f.name}" for f in self.funcs
+        )

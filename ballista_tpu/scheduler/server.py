@@ -59,6 +59,10 @@ class SchedulerServer:
         self.catalog = ExecutionContext(self.config)
         self.synchronous_planning = synchronous_planning
         self._lock = threading.Lock()
+        self._last_lost_check = 0.0
+        # tasks running on executors whose lease lapsed are rescheduled this
+        # often (the reference loses such work permanently)
+        self.lost_task_check_interval = 5.0
 
     # -- RPC implementations ------------------------------------------------
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None) -> pb.ExecuteQueryResult:
@@ -119,8 +123,16 @@ class SchedulerServer:
         log.info("job %s planned into %d stages", job_id, len(stages))
 
     def PollWork(self, request: pb.PollWorkParams, context=None) -> pb.PollWorkResult:
+        import time as _time
+
         with self.state.kv.lock():
             self.state.save_executor_metadata(request.metadata)
+            now = _time.time()
+            if now - self._last_lost_check > self.lost_task_check_interval:
+                self._last_lost_check = now
+                n = self.state.reset_lost_tasks()
+                if n:
+                    log.warning("re-scheduled %d tasks from dead executors", n)
             jobs = set()
             for ts in request.task_status:
                 self.state.save_task_status(ts)

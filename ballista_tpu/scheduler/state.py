@@ -119,6 +119,42 @@ class SchedulerState:
             out.append(t)
         return out
 
+    # -- failure recovery ---------------------------------------------------
+    def reset_lost_tasks(self) -> int:
+        """Re-schedule work lost to dead executors (beyond the reference,
+        which loses in-flight work permanently — SURVEY §5 'no retry').
+
+        A task RUNNING on an executor whose lease expired goes back to
+        pending; a COMPLETED task whose output lives on a dead executor also
+        goes back to pending (its shuffle files are unreachable), which
+        recursively invalidates dependents via the normal runnability check.
+        Returns the number of tasks reset."""
+        alive = {m.id for m in self.get_executors_metadata()}
+        finished_jobs: Dict[str, bool] = {}
+        reset = 0
+        for t in self.get_all_tasks():
+            job_id = t.partition_id.job_id
+            if job_id not in finished_jobs:
+                js = self.get_job_metadata(job_id)
+                finished_jobs[job_id] = js is not None and js.WhichOneof("status") in (
+                    "completed",
+                    "failed",
+                )
+            if finished_jobs[job_id]:
+                continue  # don't resurrect finished jobs
+            w = t.WhichOneof("status")
+            owner = None
+            if w == "running":
+                owner = t.running.executor_id
+            elif w == "completed":
+                owner = t.completed.executor_id
+            if owner is not None and owner not in alive:
+                pending = pb.TaskStatus()
+                pending.partition_id.CopyFrom(t.partition_id)
+                self.save_task_status(pending)
+                reset += 1
+        return reset
+
     # -- scheduling ---------------------------------------------------------
     def assign_next_schedulable_task(
         self, executor_id: str

@@ -155,6 +155,14 @@ def expr_to_proto(e: lx.Expr) -> pb.LogicalExprNode:
         n.aggregate_expr.fn = e.fn
         n.aggregate_expr.expr.CopyFrom(expr_to_proto(e.expr))
         n.aggregate_expr.distinct = e.distinct
+    elif isinstance(e, lx.WindowExpr):
+        n.window_expr.fn = e.fn
+        if e.arg is not None:
+            n.window_expr.arg.CopyFrom(expr_to_proto(e.arg))
+        for pe in e.partition_by:
+            n.window_expr.partition_by.append(expr_to_proto(pe))
+        for oe in e.order_by:
+            n.window_expr.order_by.append(expr_to_proto(oe))
     elif isinstance(e, lx.SortExpr):
         n.sort_expr.expr.CopyFrom(expr_to_proto(e.expr))
         n.sort_expr.ascending = e.ascending
@@ -252,6 +260,17 @@ def expr_from_proto(n: pb.LogicalExprNode) -> lx.Expr:
         )
     if which == "wildcard":
         return lx.Wildcard()
+    if which == "window_expr":
+        w = n.window_expr
+        arg = expr_from_proto(w.arg) if w.HasField("arg") else None
+        order = []
+        for oe in w.order_by:
+            se = expr_from_proto(oe)
+            assert isinstance(se, lx.SortExpr)
+            order.append(se)
+        return lx.WindowExpr(
+            w.fn, arg, [expr_from_proto(pe) for pe in w.partition_by], order
+        )
     raise SerdeError(f"empty expr node {n}")
 
 

@@ -40,6 +40,7 @@ from ballista_tpu.physical.plan import ExecutionPlan, Partitioning
 from ballista_tpu.physical.repartition import RepartitionExec
 from ballista_tpu.physical.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
 from ballista_tpu.physical.union import UnionExec
+from ballista_tpu.physical.window import WindowExec
 from ballista_tpu.proto import ballista_pb2 as pb
 from ballista_tpu.serde.logical import (
     expr_from_proto,
@@ -206,6 +207,21 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         n.shuffle_reader.schema_ipc = schema_to_ipc(plan.schema())
         n.shuffle_reader.num_partitions = plan.num_partitions
         n.shuffle_reader.identity = plan.identity
+    elif isinstance(plan, WindowExec):
+        n.window.input.CopyFrom(phys_plan_to_proto(plan.input))
+        for f in plan.funcs:
+            wf = n.window.funcs.add()
+            wf.fn = f.fn
+            if f.arg is not None:
+                wf.arg.CopyFrom(expr_to_proto(uncompile_expr(f.arg)))
+            for p_ in f.partition_by:
+                wf.partition_by.append(expr_to_proto(uncompile_expr(p_)))
+            for oe, asc in f.order_by:
+                wf.order_by.append(
+                    expr_to_proto(lx.SortExpr(uncompile_expr(oe), asc, False))
+                )
+            wf.name = f.name
+            wf.dtype_ipc = dtype_to_ipc(f.dtype)
     elif isinstance(plan, UnresolvedShuffleExec):
         n.unresolved_shuffle.stage_id = plan.stage_id
         n.unresolved_shuffle.schema_ipc = schema_to_ipc(plan.schema())
@@ -360,6 +376,36 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
             n.shuffle_reader.num_partitions,
             identity=n.shuffle_reader.identity,
         )
+    if which == "window":
+        from ballista_tpu.physical.window import WindowExec, WindowFuncDesc
+
+        input = phys_plan_from_proto(n.window.input)
+        schema = input.schema()
+        funcs = []
+        for wf in n.window.funcs:
+            arg = (
+                create_physical_expr(expr_from_proto(wf.arg), schema)
+                if wf.HasField("arg")
+                else None
+            )
+            order = []
+            for oe in wf.order_by:
+                se = expr_from_proto(oe)
+                order.append((create_physical_expr(se.expr, schema), se.ascending))
+            funcs.append(
+                WindowFuncDesc(
+                    wf.fn,
+                    arg,
+                    [
+                        create_physical_expr(expr_from_proto(pe), schema)
+                        for pe in wf.partition_by
+                    ],
+                    order,
+                    wf.name,
+                    dtype_from_ipc(wf.dtype_ipc),
+                )
+            )
+        return WindowExec(input, funcs)
     if which == "unresolved_shuffle":
         return UnresolvedShuffleExec(
             n.unresolved_shuffle.stage_id,

@@ -21,7 +21,7 @@ KEYWORDS = {
     "nulls", "first", "last", "date", "interval", "timestamp", "time",
     "extract", "substring", "for", "create", "external", "table", "stored",
     "location", "with", "header", "row", "options", "explain", "analyze",
-    "verbose", "escape",
+    "verbose", "escape", "over", "partition",
 }
 
 

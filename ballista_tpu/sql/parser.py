@@ -584,13 +584,45 @@ class Parser:
             while self.eat_op(","):
                 args.append(self.parse_expr())
         self.expect_op(")")
+        if self.at_keyword("over"):
+            return self._parse_over(fname, args, distinct)
         if fname in lx.AGGREGATE_FUNCTIONS:
             if len(args) != 1:
                 raise SqlError(f"{name} takes one argument")
             return lx.AggregateExpr(fname, args[0], distinct)
+        if fname in ("row_number", "rank", "dense_rank"):
+            raise SqlError(f"{name} requires an OVER clause")
         if distinct:
             raise SqlError("DISTINCT only valid in aggregates")
         return lx.ScalarFunction(fname, args)
+
+    def _parse_over(self, fname, args, distinct):
+        if distinct:
+            raise SqlError("DISTINCT not supported in window functions")
+        self.expect_keyword("over")
+        self.expect_op("(")
+        partition_by = []
+        order_by = []
+        if self.eat_keyword("partition"):
+            self.expect_keyword("by")
+            partition_by.append(self.parse_expr())
+            while self.eat_op(","):
+                partition_by.append(self.parse_expr())
+        if self.eat_keyword("order"):
+            self.expect_keyword("by")
+            while True:
+                e = self.parse_expr()
+                asc = True
+                if self.eat_keyword("desc"):
+                    asc = False
+                else:
+                    self.eat_keyword("asc")
+                order_by.append(lx.SortExpr(e, asc, False))
+                if not self.eat_op(","):
+                    break
+        self.expect_op(")")
+        arg = args[0] if args else None
+        return lx.WindowExpr(fname, arg, partition_by, order_by)
 
     def _parse_case(self) -> lx.Expr:
         self.expect_keyword("case")

@@ -81,12 +81,23 @@ def contains_subquery(e: lx.Expr) -> bool:
 
 
 def collect_aggregates(e: lx.Expr, out: List[lx.AggregateExpr]) -> None:
+    if isinstance(e, lx.WindowExpr):
+        return  # window-function internals are not GROUP BY aggregates
     if isinstance(e, lx.AggregateExpr):
         if not any(a.equals(e) for a in out):
             out.append(e)
         return
     for c in e.children():
         collect_aggregates(c, out)
+
+
+def collect_windows(e: lx.Expr, out: List["lx.WindowExpr"]) -> None:
+    if isinstance(e, lx.WindowExpr):
+        if not any(str(w) == str(e) for w in out):
+            out.append(e)
+        return
+    for c in e.children():
+        collect_windows(c, out)
 
 
 def rewrite_expr(e: lx.Expr, mapping: Dict[str, lx.Expr]) -> lx.Expr:
@@ -147,6 +158,13 @@ def rewrite_expr(e: lx.Expr, mapping: Dict[str, lx.Expr]) -> lx.Expr:
         return lx.SortExpr(rewrite_expr(e.expr, mapping), e.ascending, e.nulls_first)
     if isinstance(e, lx.AggregateExpr):
         return lx.AggregateExpr(e.fn, rewrite_expr(e.expr, mapping), e.distinct)
+    if isinstance(e, lx.WindowExpr):
+        return lx.WindowExpr(
+            e.fn,
+            None if e.arg is None else rewrite_expr(e.arg, mapping),
+            [rewrite_expr(p, mapping) for p in e.partition_by],
+            [rewrite_expr(o, mapping) for o in e.order_by],
+        )
     return e
 
 
@@ -257,6 +275,16 @@ class SelectPlanner:
             if stmt.having is not None:
                 raise SqlError("HAVING requires GROUP BY or aggregates")
             self._order_mapping = {}
+
+        # window functions evaluate over the (post-aggregate) relation
+        wexprs: List[lx.Expr] = []
+        for e in select_exprs:
+            collect_windows(e, wexprs)
+        if wexprs:
+            plan = lp.Window(plan, wexprs)
+            wmap = {str(w): lx.Column(w.output_name()) for w in wexprs}
+            select_exprs = [rewrite_expr(e, wmap) for e in select_exprs]
+            self._order_mapping.update(wmap)
 
         plan = lp.Projection(plan, select_exprs)
         if stmt.distinct:

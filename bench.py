@@ -24,8 +24,11 @@ sys.path.insert(0, str(REPO))
 
 SF = float(os.environ.get("BENCH_SF", "1"))
 DATA = REPO / ".bench_cache" / f"tpch_sf{SF}"
-QUERY = (REPO / "benchmarks" / "tpch" / "queries" / "q1.sql").read_text()
+QUERIES_DIR = REPO / "benchmarks" / "tpch" / "queries"
+QUERY = (QUERIES_DIR / "q1.sql").read_text()
 BATCH = "16777216"
+# secondary configs reported to stderr (BASELINE.md configs 1 and 3)
+SIDE_QUERIES = ["q6", "q3"]
 
 
 def ensure_data() -> None:
@@ -37,7 +40,7 @@ def ensure_data() -> None:
     generate(str(DATA), sf=SF, parts=1)
 
 
-def run_once(backend: str) -> float:
+def run_once(backend: str, sql: str = QUERY) -> float:
     from ballista_tpu.config import BallistaConfig
     from ballista_tpu.engine import ExecutionContext
     from benchmarks.tpch.datagen import register_all
@@ -52,7 +55,7 @@ def run_once(backend: str) -> float:
     )
     register_all(ctx, str(DATA))
     t0 = time.perf_counter()
-    out = ctx.sql(QUERY).collect()
+    out = ctx.sql(sql).collect()
     dt = time.perf_counter() - t0
     assert out.num_rows >= 1
     return dt
@@ -71,6 +74,21 @@ def main() -> None:
     tpu_dt = min(run_once("tpu"), run_once("tpu"))
     cpu_dt = run_once("cpu")
     cpu_dt = min(cpu_dt, run_once("cpu"))
+
+    # secondary configs (stderr, not the tracked metric)
+    for q in SIDE_QUERIES:
+        sql = (QUERIES_DIR / f"{q}.sql").read_text()
+        try:
+            run_once("tpu", sql)
+            t = min(run_once("tpu", sql), run_once("tpu", sql))
+            c = min(run_once("cpu", sql), run_once("cpu", sql))
+            print(
+                f"[side] {q}: tpu={t*1000:.0f}ms cpu={c*1000:.0f}ms "
+                f"speedup={c/t:.2f}x",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(f"[side] {q}: failed: {e}", file=sys.stderr)
 
     value = rows / tpu_dt
     baseline = rows / cpu_dt

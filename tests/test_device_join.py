@@ -1,0 +1,63 @@
+"""Device join kernel vs host join oracle."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.ops.join import device_join_indices
+
+
+def test_device_join_indices_basic():
+    build = np.array([10, 3, 7, 1], dtype=np.int64)
+    probe = np.array([7, 7, 2, 10, 1], dtype=np.int64)
+    build_idx, mask = device_join_indices(build, probe)
+    assert mask.tolist() == [True, True, False, True, True]
+    assert build_idx[mask].tolist() == [2, 2, 0, 3]
+
+
+def test_device_join_declines_duplicates():
+    build = np.array([5, 5, 6], dtype=np.int64)
+    probe = np.array([5], dtype=np.int64)
+    assert device_join_indices(build, probe) is None
+
+
+def test_device_join_null_probe_keys():
+    build = np.array([1, 2, 3], dtype=np.int64)
+    probe = np.array([2, -1, 3], dtype=np.int64)  # -1 = null code
+    build_idx, mask = device_join_indices(build, probe)
+    assert mask.tolist() == [True, False, True]
+
+
+@pytest.mark.parametrize("n", [1000, 5000])
+def test_device_join_vs_host_random(n):
+    rng = np.random.default_rng(3)
+    build = rng.permutation(n * 2)[:n].astype(np.int64)  # unique
+    probe = rng.integers(0, n * 2, n * 3).astype(np.int64)
+    build_idx, mask = device_join_indices(build, probe)
+    lookup = {int(k): i for i, k in enumerate(build)}
+    for j in range(len(probe)):
+        want = lookup.get(int(probe[j]), -1)
+        assert build_idx[j] == want
+
+
+def _tpch_join_sql():
+    return (
+        "select o_orderkey, c_name, o_totalprice from orders, customer "
+        "where o_custkey = c_custkey and o_totalprice > 100000 "
+        "order by o_totalprice desc limit 10"
+    )
+
+
+def test_tpu_backend_join_matches_cpu(tmp_path_factory):
+    from benchmarks.tpch.datagen import generate, register_all
+
+    d = str(tmp_path_factory.mktemp("tpch_join"))
+    generate(d, sf=0.002, parts=2)
+    out = {}
+    for backend in ("cpu", "tpu"):
+        ctx = ExecutionContext(BallistaConfig({"ballista.executor.backend": backend}))
+        register_all(ctx, d)
+        out[backend] = ctx.sql(_tpch_join_sql()).collect().to_pylist()
+    assert out["cpu"] == out["tpu"]
