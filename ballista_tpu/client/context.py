@@ -100,15 +100,12 @@ class BallistaContext(ExecutionContext):
         raise ExecutionError(f"job {job_id} timed out after {timeout}s")
 
     def _fetch_partition(self, loc: pb.PartitionLocation) -> pa.Table:
-        action = pb.Action()
-        # the final stage writes piece 0 per input partition
-        action.fetch_partition.path = os.path.join(loc.path, "0.arrow")
-        client = flight.connect(
-            f"grpc://{loc.executor_meta.host}:{loc.executor_meta.port}"
-        )
+        from ballista_tpu.client.flight import BallistaClient
+
+        client = BallistaClient(loc.executor_meta.host, loc.executor_meta.port)
         try:
-            reader = client.do_get(flight.Ticket(action.SerializeToString()))
-            return reader.read_all()
+            # the final stage writes piece 0 per input partition
+            return client.fetch_partition(os.path.join(loc.path, "0.arrow"))
         finally:
             client.close()
 

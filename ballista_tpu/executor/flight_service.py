@@ -84,12 +84,12 @@ class BallistaFlightService(flight.FlightServerBase):
 def flight_shuffle_fetcher(loc: ShuffleLocation, partition: int) -> Iterator[pa.RecordBatch]:
     """ShuffleReaderExec's remote path: Flight do_get(FetchPartition) against
     the executor owning the piece (ref client.rs:123-169)."""
+    from ballista_tpu.client.flight import BallistaClient
+
     action = pb.Action()
     action.fetch_partition.path = os.path.join(loc.path, f"{partition}.arrow")
-    client = flight.connect(f"grpc://{loc.host}:{loc.port}")
+    client = BallistaClient(loc.host, loc.port)
     try:
-        reader = client.do_get(flight.Ticket(action.SerializeToString()))
-        for chunk in reader:
-            yield chunk.data
+        yield from client.stream_action(action)
     finally:
         client.close()

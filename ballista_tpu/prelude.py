@@ -1,0 +1,25 @@
+"""Convenience re-exports (the reference's prelude, rust/client/src/prelude.rs).
+
+    from ballista_tpu.prelude import *
+"""
+
+from ballista_tpu.client import BallistaContext, BallistaDataFrame  # noqa: F401
+from ballista_tpu.client.flight import BallistaClient  # noqa: F401
+from ballista_tpu.config import BallistaConfig  # noqa: F401
+from ballista_tpu.engine import DataFrame, ExecutionContext  # noqa: F401
+from ballista_tpu.errors import BallistaError  # noqa: F401
+from ballista_tpu.logical import col, lit  # noqa: F401
+from ballista_tpu.logical.expr import functions  # noqa: F401
+
+__all__ = [
+    "BallistaContext",
+    "BallistaDataFrame",
+    "BallistaClient",
+    "BallistaConfig",
+    "DataFrame",
+    "ExecutionContext",
+    "BallistaError",
+    "col",
+    "lit",
+    "functions",
+]

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Integration test driver (ref dev/integration-tests.sh + rust/benchmarks/tpch/run.sh):
+# generate TPC-H data, start a cluster, run the reference's integration query
+# set (q1, q3, q5, q6, q10, q12) through a real scheduler + executors.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:${PYTHONPATH:-}"
+
+DATA=${DATA:-/tmp/ballista-tpu-it}
+SF=${SF:-0.01}
+
+[ -d "$DATA/lineitem" ] || python -m benchmarks.tpch.runner datagen --sf "$SF" --out "$DATA" --parts 2
+
+python - <<'PY'
+import os, pathlib, sys
+sys.path.insert(0, os.getcwd())
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.executor.runtime import StandaloneCluster
+from benchmarks.tpch.datagen import register_all
+
+data = os.environ.get("DATA", "/tmp/ballista-tpu-it")
+cluster = StandaloneCluster(n_executors=2)
+ctx = BallistaContext(*cluster.scheduler_addr)
+register_all(ctx, data)
+for q in (1, 3, 5, 6, 10, 12):
+    sql = pathlib.Path(f"benchmarks/tpch/queries/q{q}.sql").read_text()
+    out = ctx.sql(sql).collect()
+    print(f"q{q}: OK ({out.num_rows} rows)")
+cluster.shutdown()
+print("integration tests passed")
+PY

@@ -1,0 +1,54 @@
+"""BallistaClient (Flight wrapper) tests: the push-based ExecutePartition
+path (ref BallistaClient::execute_partition) and fetch_partition."""
+
+import os
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.client.flight import BallistaClient
+from ballista_tpu.errors import RpcError
+
+
+def test_prelude_imports():
+    import ballista_tpu.prelude as p
+
+    assert p.col("x").name == "x"
+    assert callable(p.functions.sum)
+
+
+def test_execute_and_fetch_partition(sales_table, tmp_path):
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.executor.flight_service import BallistaFlightService
+    import threading
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    svc = BallistaFlightService(
+        f"grpc://0.0.0.0:{port}", str(tmp_path), BallistaConfig()
+    )
+    t = threading.Thread(target=svc.serve, daemon=True)
+    t.start()
+
+    # build a plan locally and push it to the executor
+    ctx = ExecutionContext()
+    ctx.register_record_batches("sales", sales_table, n_partitions=2)
+    from ballista_tpu.logical import col, functions as F
+
+    df = ctx.table("sales").aggregate([], [F.sum(col("amount")).alias("s")])
+    physical = ctx.create_physical_plan(df.logical_plan())
+
+    client = BallistaClient("127.0.0.1", port)
+    results = client.execute_partition("jobf", 1, [0], physical)
+    assert len(results) == 1
+    path, stats = results[0]
+    assert stats.num_rows == 1
+
+    fetched = client.fetch_partition(os.path.join(path, "0.arrow"))
+    assert fetched.column("s").to_pylist() == [305.0]
+    client.close()
+    svc.shutdown()
