@@ -169,19 +169,53 @@ class FusedAggregateStage:
         aggs = self.aggs
 
         BLOCK = 8192
+        # XLA lowers segment_* to scatter, which serializes on TPU. For small
+        # group counts an unrolled per-group masked reduction is pure
+        # HBM-bandwidth work on the VPU (G linear passes, each a tree
+        # reduction — which also gives the accuracy of pairwise summation).
+        UNROLL_G = 33
 
         def seg_sum(v, safe_codes, num_segments, n):
-            """Float segment sum. For low group counts, accumulate per
-            (group, block) first, then reduce blocks — bounds f32 error to
-            ~sqrt(n/BLOCK)*eps instead of ~n*eps (hierarchical summation)."""
+            """Float segment sum with accuracy-preserving strategies."""
+            if num_segments <= UNROLL_G:
+                groups = [
+                    jnp.sum(jnp.where(safe_codes == g, v, 0.0))
+                    for g in range(num_segments)
+                ]
+                return jnp.stack(groups)
             nb = max(1, n // BLOCK)
             if num_segments <= 257 and nb > 1:
+                # hierarchical: per-(group, block) partials, then block reduce
                 block_id = jnp.arange(n, dtype=jnp.int32) // BLOCK
                 wide = jax.ops.segment_sum(
                     v, safe_codes * nb + block_id, num_segments=num_segments * nb
                 )
                 return wide.reshape(num_segments, nb).sum(axis=1)
             return jax.ops.segment_sum(v, safe_codes, num_segments=num_segments)
+
+        def seg_count(mask, safe_codes, num_segments):
+            if num_segments <= UNROLL_G:
+                groups = [
+                    jnp.sum(jnp.where(safe_codes == g, 1, 0), dtype=jnp.int32)
+                    for g in range(num_segments)
+                ]
+                return jnp.stack(groups).astype(jnp.float32)
+            return jax.ops.segment_sum(
+                mask.astype(jnp.int32), safe_codes, num_segments=num_segments
+            ).astype(jnp.float32)
+
+        def seg_extreme(v, mask, safe_codes, num_segments, largest):
+            fill = -jnp.inf if largest else jnp.inf
+            if num_segments <= UNROLL_G:
+                red = jnp.max if largest else jnp.min
+                groups = [
+                    red(jnp.where(safe_codes == g, v, fill))
+                    for g in range(num_segments)
+                ]
+                return jnp.stack(groups)
+            vm = jnp.where(mask, v, fill)
+            op = jax.ops.segment_max if largest else jax.ops.segment_min
+            return op(vm, safe_codes, num_segments=num_segments)
 
         @functools.partial(jax.jit, static_argnums=(0,))
         def step(num_segments, cols, aux, codes, row_valid):
@@ -192,10 +226,8 @@ class FusedAggregateStage:
             maskf = mask.astype(jnp.float32)
             outputs = []
             safe_codes = jnp.where(mask, codes, num_segments - 1)
-            # counts in int32: exact up to 2^31 (f32 loses exactness at 2^24)
-            counts = jax.ops.segment_sum(
-                mask.astype(jnp.int32), safe_codes, num_segments=num_segments
-            ).astype(jnp.float32)
+            # counts exact in int32 (f32 loses exactness at 2^24)
+            counts = seg_count(mask, safe_codes, num_segments)
             for a, vf in zip(aggs, value_fns):
                 if a.fn == "count":
                     outputs.append(counts)
@@ -203,20 +235,13 @@ class FusedAggregateStage:
                 v = vf.fn(cols, aux).astype(jnp.float32)
                 v = jnp.broadcast_to(v, mask.shape)
                 if a.fn in ("sum", "avg"):
-                    s = seg_sum(v * maskf, safe_codes, num_segments, n)
-                    outputs.append(s)
+                    outputs.append(seg_sum(v * maskf, safe_codes, num_segments, n))
                     if a.fn == "avg":
                         outputs.append(counts)
                 elif a.fn == "min":
-                    vm = jnp.where(mask, v, jnp.inf)
-                    outputs.append(
-                        jax.ops.segment_min(vm, safe_codes, num_segments=num_segments)
-                    )
+                    outputs.append(seg_extreme(v, mask, safe_codes, num_segments, False))
                 elif a.fn == "max":
-                    vm = jnp.where(mask, v, -jnp.inf)
-                    outputs.append(
-                        jax.ops.segment_max(vm, safe_codes, num_segments=num_segments)
-                    )
+                    outputs.append(seg_extreme(v, mask, safe_codes, num_segments, True))
             # one stacked result -> ONE device->host transfer per batch
             # (d2h latency dominates on relay-attached chips)
             return jnp.stack([counts] + outputs)
