@@ -39,6 +39,10 @@ from ballista_tpu.physical.scan import CsvScanExec, MemoryScanExec, ParquetScanE
 
 _SCAN_TYPES = (CsvScanExec, ParquetScanExec, MemoryScanExec)
 
+# the device path aggregates with G unrolled reductions; beyond this the host
+# hash aggregate wins (XLA segment_* scatter serializes on TPU)
+MAX_GROUPS = 1024
+
 
 def substitute_columns(e: px.PhysicalExpr, mapping: List[px.PhysicalExpr]) -> px.PhysicalExpr:
     """Inline projection outputs: ColumnExpr(i) -> mapping[i]."""
@@ -168,66 +172,48 @@ class FusedAggregateStage:
         value_fns = self.value_fns
         aggs = self.aggs
 
-        BLOCK = 8192
-        # XLA lowers segment_* to scatter, which serializes on TPU. For small
-        # group counts an unrolled per-group masked reduction is pure
-        # HBM-bandwidth work on the VPU (G linear passes, each a tree
-        # reduction — which also gives the accuracy of pairwise summation).
-        UNROLL_G = 33
+        # XLA lowers segment_* to scatter, which serializes on TPU (measured
+        # 460ms vs ~5ms for 6M rows). Group counts are capped at MAX_GROUPS (run())
+        # by run(), so every aggregation is an unrolled per-group masked
+        # reduction: pure HBM-bandwidth work on the VPU, G linear passes,
+        # each a tree reduction (pairwise-summation accuracy).
 
-        def seg_sum(v, safe_codes, num_segments, n):
-            """Float segment sum with accuracy-preserving strategies."""
-            if num_segments <= UNROLL_G:
-                groups = [
+        def seg_sum(v, safe_codes, num_segments):
+            return jnp.stack(
+                [
                     jnp.sum(jnp.where(safe_codes == g, v, 0.0))
                     for g in range(num_segments)
                 ]
-                return jnp.stack(groups)
-            nb = max(1, n // BLOCK)
-            if num_segments <= 257 and nb > 1:
-                # hierarchical: per-(group, block) partials, then block reduce
-                block_id = jnp.arange(n, dtype=jnp.int32) // BLOCK
-                wide = jax.ops.segment_sum(
-                    v, safe_codes * nb + block_id, num_segments=num_segments * nb
-                )
-                return wide.reshape(num_segments, nb).sum(axis=1)
-            return jax.ops.segment_sum(v, safe_codes, num_segments=num_segments)
+            )
 
-        def seg_count(mask, safe_codes, num_segments):
-            if num_segments <= UNROLL_G:
-                groups = [
+        def seg_count(safe_codes, num_segments):
+            # int32 counts: exact where f32 loses exactness at 2^24
+            return jnp.stack(
+                [
                     jnp.sum(jnp.where(safe_codes == g, 1, 0), dtype=jnp.int32)
                     for g in range(num_segments)
                 ]
-                return jnp.stack(groups).astype(jnp.float32)
-            return jax.ops.segment_sum(
-                mask.astype(jnp.int32), safe_codes, num_segments=num_segments
             ).astype(jnp.float32)
 
-        def seg_extreme(v, mask, safe_codes, num_segments, largest):
+        def seg_extreme(v, safe_codes, num_segments, largest):
             fill = -jnp.inf if largest else jnp.inf
-            if num_segments <= UNROLL_G:
-                red = jnp.max if largest else jnp.min
-                groups = [
+            red = jnp.max if largest else jnp.min
+            return jnp.stack(
+                [
                     red(jnp.where(safe_codes == g, v, fill))
                     for g in range(num_segments)
                 ]
-                return jnp.stack(groups)
-            vm = jnp.where(mask, v, fill)
-            op = jax.ops.segment_max if largest else jax.ops.segment_min
-            return op(vm, safe_codes, num_segments=num_segments)
+            )
 
         @functools.partial(jax.jit, static_argnums=(0,))
         def step(num_segments, cols, aux, codes, row_valid):
-            n = codes.shape[0]
             mask = row_valid
             for f in filter_fns:
                 mask = jnp.logical_and(mask, f.fn(cols, aux))
             maskf = mask.astype(jnp.float32)
-            outputs = []
             safe_codes = jnp.where(mask, codes, num_segments - 1)
-            # counts exact in int32 (f32 loses exactness at 2^24)
-            counts = seg_count(mask, safe_codes, num_segments)
+            outputs = []
+            counts = seg_count(safe_codes, num_segments)
             for a, vf in zip(aggs, value_fns):
                 if a.fn == "count":
                     outputs.append(counts)
@@ -235,13 +221,13 @@ class FusedAggregateStage:
                 v = vf.fn(cols, aux).astype(jnp.float32)
                 v = jnp.broadcast_to(v, mask.shape)
                 if a.fn in ("sum", "avg"):
-                    outputs.append(seg_sum(v * maskf, safe_codes, num_segments, n))
+                    outputs.append(seg_sum(v * maskf, safe_codes, num_segments))
                     if a.fn == "avg":
                         outputs.append(counts)
                 elif a.fn == "min":
-                    outputs.append(seg_extreme(v, mask, safe_codes, num_segments, False))
+                    outputs.append(seg_extreme(v, safe_codes, num_segments, False))
                 elif a.fn == "max":
-                    outputs.append(seg_extreme(v, mask, safe_codes, num_segments, True))
+                    outputs.append(seg_extreme(v, safe_codes, num_segments, True))
             # one stacked result -> ONE device->host transfer per batch
             # (d2h latency dominates on relay-attached chips)
             return jnp.stack([counts] + outputs)
@@ -275,7 +261,7 @@ class FusedAggregateStage:
         for _c, dv in encoded:
             card *= max(1, len(dv))
 
-        if card <= 65536:
+        if card <= 1024:
             # dense fast path: combined dictionary code IS the group id — no
             # np.unique pass; empty groups are dropped later (counts == 0)
             combined = np.zeros(n, dtype=np.int64)
@@ -355,6 +341,15 @@ class FusedAggregateStage:
                 continue
             n = batch.num_rows
             bucket = bucket_rows(n)
+            # group codes FIRST: a high-cardinality decline must not pay the
+            # column upload
+            codes, key_values, n_groups = self._group_codes(batch)
+            if n_groups == 0:
+                continue
+            if n_groups > MAX_GROUPS:
+                # high-cardinality group-by: XLA's scatter lowering loses to
+                # the host hash aggregate — decline the whole stage
+                raise UnsupportedOnDevice(f"{n_groups} groups exceeds device path")
             cols: Dict[int, object] = {}
             for idx, dtype in self.compiler.used_columns.items():
                 arr = batch.column(idx)
@@ -362,9 +357,6 @@ class FusedAggregateStage:
                 npcol = column_to_numpy(arr, dtype, d)
                 fill = False if npcol.dtype == np.bool_ else 0
                 cols[idx] = jnp.asarray(pad_to(npcol, bucket, fill))
-            codes, key_values, n_groups = self._group_codes(batch)
-            if n_groups == 0:
-                continue
             seg_bucket = bucket_rows(n_groups, 16) + 1  # +1 dump slot
             codes_pad = pad_to(codes.astype(np.int32), bucket, 0)
             row_valid = np.zeros(bucket, dtype=np.bool_)

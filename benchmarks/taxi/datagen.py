@@ -1,0 +1,83 @@
+"""NYC-taxi-shaped trip data generator (BASELINE.md config #4:
+high-cardinality group-by over a Parquet scan).
+
+Schema follows the TLC yellow-cab trip records: 265 location zones, vendor
+ids, timestamps, distances, fares. Deterministic and SF-scalable
+(sf=1 -> ~10M trips, roughly a month of NYC volume).
+
+Usage: python -m benchmarks.taxi.datagen --sf 0.1 --out /tmp/taxi
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+N_ZONES = 265
+
+
+def gen_trips(sf: float, seed: int = 20260728) -> pa.Table:
+    n = max(1, int(10_000_000 * sf))
+    rng = np.random.default_rng(seed)
+    # zone popularity follows a heavy tail like the real data
+    zone_weights = rng.pareto(1.2, N_ZONES) + 1
+    zone_weights /= zone_weights.sum()
+    pu = rng.choice(N_ZONES, n, p=zone_weights).astype(np.int64) + 1
+    do = rng.choice(N_ZONES, n, p=zone_weights).astype(np.int64) + 1
+    start = np.datetime64("2024-01-01").astype("datetime64[s]").astype(np.int64)
+    pickup_ts = start + rng.integers(0, 31 * 24 * 3600, n)
+    duration = rng.gamma(2.0, 420.0, n).astype(np.int64) + 60
+    distance = np.round(rng.gamma(2.0, 1.6, n), 2)
+    fare = np.round(3.0 + distance * 2.5 + duration / 60 * 0.5, 2)
+    tip = np.round(fare * rng.beta(2, 8, n), 2)
+    return pa.table(
+        {
+            "vendor_id": rng.integers(1, 3, n),
+            "pickup_datetime": pa.array(pickup_ts, type=pa.timestamp("s")),
+            "pickup_location_id": pu,
+            "dropoff_location_id": do,
+            "passenger_count": rng.integers(1, 7, n),
+            "trip_distance": distance,
+            "fare_amount": fare,
+            "tip_amount": tip,
+            "total_amount": np.round(fare + tip, 2),
+        }
+    )
+
+
+# the benchmark query: high-cardinality group-by + multiple aggregates
+TRIP_AGG_QUERY = """
+    select pickup_location_id,
+           count(*) as trips,
+           sum(total_amount) as revenue,
+           avg(trip_distance) as avg_distance,
+           avg(tip_amount) as avg_tip
+    from trips
+    group by pickup_location_id
+    order by revenue desc
+    limit 20
+"""
+
+
+def generate(out_dir: str, sf: float = 0.1, parts: int = 1, seed: int = 20260728) -> None:
+    table = gen_trips(sf, seed)
+    d = os.path.join(out_dir, "trips")
+    os.makedirs(d, exist_ok=True)
+    n = table.num_rows
+    step = (n + parts - 1) // parts
+    for p in range(parts):
+        pq.write_table(table.slice(p * step, step), os.path.join(d, f"part-{p:03d}.parquet"))
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--parts", type=int, default=1)
+    a = ap.parse_args()
+    generate(a.out, a.sf, a.parts)
+    print(f"taxi sf={a.sf} written to {a.out}")

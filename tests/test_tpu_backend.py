@@ -139,3 +139,18 @@ def test_civil_from_days():
     assert list(np.asarray(y)) == [d.year for d in dates]
     assert list(np.asarray(m)) == [d.month for d in dates]
     assert list(np.asarray(dd)) == [d.day for d in dates]
+
+
+def test_taxi_high_cardinality_groupby(tmp_path_factory):
+    """BASELINE config #4: heavy-tailed 265-zone group-by (medium-G device
+    path) matches the host backend."""
+    from benchmarks.taxi.datagen import TRIP_AGG_QUERY, generate
+
+    d = str(tmp_path_factory.mktemp("taxi"))
+    generate(d, sf=0.01, parts=2)
+    out = {}
+    for backend in ("cpu", "tpu"):
+        ctx = make_ctx(backend)
+        ctx.register_parquet("trips", f"{d}/trips")
+        out[backend] = ctx.sql(TRIP_AGG_QUERY).collect().to_pandas()
+    assert_close(out["cpu"], out["tpu"], rtol=1e-5)
