@@ -64,31 +64,36 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
 
     from ballista_tpu.physical.scan import MemoryScanExec
 
-    node = exec_node
-    while node.children():
-        node = node.children()[0]
-    if isinstance(node, MemoryScanExec):
-        suffix = str(id(node.source))
-    elif hasattr(node, "source") and hasattr(node.source, "files"):
-        # include file mtimes so a rewritten file invalidates the cached
-        # stage (and its device-resident columns)
-        suffix = ",".join(
-            f"{f}:{os.path.getmtime(f) if os.path.exists(f) else 0}"
-            for f in node.source.files
-        )
-    else:
-        suffix = ""
-    key = exec_node.display_indent() + "|" + suffix
+    def leaves(node):
+        if not node.children():
+            yield node
+        for c in node.children():
+            yield from leaves(c)
+
+    parts = []
+    pinned = []
+    for leaf in leaves(exec_node):
+        if isinstance(leaf, MemoryScanExec):
+            parts.append(str(id(leaf.source)))
+            pinned.append(leaf.source)
+        elif hasattr(leaf, "source") and hasattr(leaf.source, "files"):
+            # include file mtimes so a rewritten file invalidates the cached
+            # stage (and its device-resident columns)
+            parts.extend(
+                f"{f}:{os.path.getmtime(f) if os.path.exists(f) else 0}"
+                for f in leaf.source.files
+            )
+    key = exec_node.display_indent() + "|" + ",".join(parts)
     stage = _stage_cache.get(key)
     if stage is None:
         try:
             stage = FusedAggregateStage(exec_node)
         except UnsupportedOnDevice:
             _stage_cache[key] = False
-            _stage_cache_pins[key] = node.source if hasattr(node, "source") else None
+            _stage_cache_pins[key] = pinned
             return None
         _stage_cache[key] = stage
-        _stage_cache_pins[key] = node.source if hasattr(node, "source") else None
+        _stage_cache_pins[key] = pinned
     if stage is False:
         return None
     try:

@@ -94,21 +94,25 @@ class FusedAggregateStage:
     def __init__(self, agg) -> None:
         from ballista_tpu.physical.aggregate import AggregateFunc
 
-        # --- walk the operator chain down to the scan -------------------
+        # --- walk the operator chain down to the row source --------------
+        # Filters/projections fuse onto the device; whatever sits below them
+        # (a scan, or e.g. a host hash join) becomes the row source — so a
+        # join-under-aggregate still gets device aggregation.
         node = agg.input
         stack: List[Tuple[str, object]] = []
-        while not isinstance(node, _SCAN_TYPES):
+        while isinstance(node, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
             if isinstance(node, FilterExec):
                 stack.append(("filter", node.predicate))
                 node = node.input
             elif isinstance(node, ProjectionExec):
                 stack.append(("project", node.exprs))
                 node = node.input
-            elif isinstance(node, CoalesceBatchesExec):
-                node = node.input
             else:
-                raise UnsupportedOnDevice(f"unfusable operator {type(node).__name__}")
+                node = node.input
         self.scan = node
+        # device columns stay resident only for file-backed scans (stable
+        # data identity); other sources re-execute per query
+        self.cacheable = isinstance(node, _SCAN_TYPES)
         scan_schema = node.schema()
 
         # --- re-express every expression against the scan schema --------
@@ -376,7 +380,7 @@ class FusedAggregateStage:
     def run(self, partition: int, ctx) -> Optional[pa.Table]:
         import jax.numpy as jnp
 
-        use_cache = ctx.config.device_cache()
+        use_cache = ctx.config.device_cache() and self.cacheable
         entries = self._device_cache.get(partition) if use_cache else None
         if entries is None:
             entries = self._prepare_partition(partition, ctx)
