@@ -28,6 +28,7 @@ BALLISTA_SCAN_CACHE_CAP = "ballista.scan.cache_cap_bytes"
 # when host<->device latency is low, so it is opt-in.
 BALLISTA_TPU_PER_OP = "ballista.tpu.per_op_dispatch"
 BALLISTA_TPU_DEVICE_JOIN = "ballista.tpu.device_join"
+BALLISTA_TPU_FUSE_VOLATILE = "ballista.tpu.fuse_volatile_sources"  # aggregate over non-scan sources
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -42,6 +43,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_SCAN_CACHE_CAP: str(4 << 30),
     BALLISTA_TPU_PER_OP: "false",
     BALLISTA_TPU_DEVICE_JOIN: "false",
+    BALLISTA_TPU_FUSE_VOLATILE: "false",
 }
 
 
@@ -91,6 +93,9 @@ class BallistaConfig(Mapping[str, str]):
 
     def tpu_device_join(self) -> bool:
         return self._settings[BALLISTA_TPU_DEVICE_JOIN].lower() in ("1", "true", "yes")
+
+    def tpu_fuse_volatile(self) -> bool:
+        return self._settings[BALLISTA_TPU_FUSE_VOLATILE].lower() in ("1", "true", "yes")
 
     def mesh_shape(self) -> Dict[str, int]:
         """Parse "data:4,model:2" into {"data": 4, "model": 2}."""

@@ -381,6 +381,11 @@ class FusedAggregateStage:
         import jax.numpy as jnp
 
         use_cache = ctx.config.device_cache() and self.cacheable
+        if not self.cacheable and not ctx.config.tpu_fuse_volatile():
+            # aggregating over a re-executed source (e.g. a host join) pays
+            # encode+transfer per query with no residency payoff — measured a
+            # wash-to-loss on relay-attached chips, so it is opt-in
+            raise UnsupportedOnDevice("volatile row source (enable ballista.tpu.fuse_volatile_sources)")
         entries = self._device_cache.get(partition) if use_cache else None
         if entries is None:
             entries = self._prepare_partition(partition, ctx)
