@@ -15,17 +15,22 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 from typing import Iterator
 
 import pyarrow as pa
 import pyarrow.flight as flight
 
 from ballista_tpu.config import BallistaConfig
-from ballista_tpu.distributed.stages import read_ipc_file, ShuffleLocation
+from ballista_tpu.distributed.stages import ShuffleLocation
 from ballista_tpu.physical.plan import TaskContext
 from ballista_tpu.proto import ballista_pb2 as pb
 
 log = logging.getLogger("ballista.executor.flight")
+
+# job ids are 7-char alphanumeric (scheduler/state.py); anything path-like
+# is hostile
+_JOB_ID_RE = re.compile(r"[A-Za-z0-9_-]{1,64}")
 
 
 class BallistaFlightService(flight.FlightServerBase):
@@ -40,20 +45,41 @@ class BallistaFlightService(flight.FlightServerBase):
         action.ParseFromString(ticket.ticket)
         which = action.WhichOneof("action_type")
         if which == "fetch_partition":
-            path = action.fetch_partition.path
+            path = self._resolve_work_path(action.fetch_partition.path)
             if not os.path.isfile(path):
                 raise flight.FlightServerError(f"no such shuffle piece: {path}")
+            # batch-at-a-time so a fetch never materializes the whole
+            # partition in executor memory (ref streams through a channel,
+            # rust/executor/src/flight_service.rs:315-333)
             reader = pa.ipc.open_file(path)
-            table = reader.read_all()
-            return flight.RecordBatchStream(table)
+            batches = (
+                reader.get_batch(i) for i in range(reader.num_record_batches)
+            )
+            return flight.GeneratorStream(reader.schema, batches)
         if which == "execute_partition":
             return self._execute_partition(action.execute_partition, action.settings)
         raise flight.FlightServerError(f"unsupported action {which!r}")
+
+    def _resolve_work_path(self, raw: str) -> str:
+        """Confine ticket paths to this executor's work_dir. The ticket comes
+        from an unauthenticated peer; without this check FetchPartition would
+        serve any readable file on the host (ADVICE r1, high)."""
+        root = os.path.realpath(self.work_dir)
+        path = os.path.realpath(raw)
+        if os.path.commonpath([root, path]) != root:
+            raise flight.FlightServerError(
+                f"path outside work_dir refused: {raw!r}"
+            )
+        return path
 
     def _execute_partition(self, req: pb.ExecutePartition, settings) -> flight.RecordBatchStream:
         from ballista_tpu.serde.physical import phys_plan_from_proto
         from ballista_tpu.distributed.stages import ShuffleWriterExec
 
+        # job_id is joined into work_dir paths by the shuffle writer; an
+        # unauthenticated peer must not steer writes outside work_dir
+        if not _JOB_ID_RE.fullmatch(req.job_id):
+            raise flight.FlightServerError(f"invalid job id {req.job_id!r}")
         plan = phys_plan_from_proto(req.plan)
         cfg = BallistaConfig({**self.config.to_dict(), **{kv.key: kv.value for kv in settings}})
         ctx = TaskContext(config=cfg, work_dir=self.work_dir, job_id=req.job_id,

@@ -76,3 +76,37 @@ def test_mesh_build_defaults():
 
     m = build_mesh()
     assert "data" in m.shape
+
+
+def test_dryrun_multichip_inprocess():
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_self_forces_platform():
+    """The driver calls dryrun_multichip in a process with ONE device; the
+    entry must force the virtual multi-device CPU platform itself
+    (MULTICHIP_r01 regression)."""
+    import os
+    import pathlib
+    import subprocess
+    import sys
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    env = dict(os.environ)
+    # child sees a 1-device CPU platform, like the driver's bare process
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""
+    code = (
+        f"import sys; sys.path.insert(0, {str(root)!r})\n"
+        "import jax\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(4)\n"
+    )
+    subprocess.run([sys.executable, "-c", code], env=env, check=True, cwd=root)
