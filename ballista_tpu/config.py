@@ -29,6 +29,9 @@ BALLISTA_SCAN_CACHE_CAP = "ballista.scan.cache_cap_bytes"
 BALLISTA_TPU_PER_OP = "ballista.tpu.per_op_dispatch"
 BALLISTA_TPU_DEVICE_JOIN = "ballista.tpu.device_join"
 BALLISTA_TPU_FUSE_VOLATILE = "ballista.tpu.fuse_volatile_sources"  # aggregate over non-scan sources
+# distributed planner: collapse Partial->hash shuffle->Final aggregations
+# into ONE mesh program (shard_map + psum over ICI, parallel/spmd_stage.py)
+BALLISTA_TPU_SPMD = "ballista.tpu.spmd_stages"
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -44,6 +47,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_PER_OP: "false",
     BALLISTA_TPU_DEVICE_JOIN: "false",
     BALLISTA_TPU_FUSE_VOLATILE: "false",
+    BALLISTA_TPU_SPMD: "false",
 }
 
 
@@ -96,6 +100,9 @@ class BallistaConfig(Mapping[str, str]):
 
     def tpu_fuse_volatile(self) -> bool:
         return self._settings[BALLISTA_TPU_FUSE_VOLATILE].lower() in ("1", "true", "yes")
+
+    def tpu_spmd(self) -> bool:
+        return self._settings[BALLISTA_TPU_SPMD].lower() in ("1", "true", "yes")
 
     def mesh_shape(self) -> Dict[str, int]:
         """Parse "data:4,model:2" into {"data": 4, "model": 2}."""

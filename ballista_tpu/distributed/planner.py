@@ -28,8 +28,9 @@ from ballista_tpu.physical.repartition import RepartitionExec
 
 
 class DistributedPlanner:
-    def __init__(self) -> None:
+    def __init__(self, config=None) -> None:
         self._next_stage_id = 0
+        self._config = config
 
     def _new_stage_id(self) -> int:
         self._next_stage_id += 1
@@ -40,11 +41,35 @@ class DistributedPlanner:
     ) -> List[ShuffleWriterExec]:
         """Returns stages in dependency order; the last is the job's root
         (its shuffle output is the query result, one piece per partition)."""
+        if self._config is not None and self._config.tpu_spmd():
+            plan = self._fuse_spmd_aggregates(plan)
         stages: List[ShuffleWriterExec] = []
         root = self._visit(plan, job_id, stages)
         final = ShuffleWriterExec(job_id, self._new_stage_id(), root, None)
         stages.append(final)
         return stages
+
+    def _fuse_spmd_aggregates(self, node: ExecutionPlan) -> ExecutionPlan:
+        """Config-gated TPU restructuring (SURVEY §7 step 5): a
+        HashAggregate(Final) <- Repartition(hash) <- HashAggregate(Partial)
+        subtree — which the exchange rule below would split into two stages
+        plus a materialized shuffle — becomes ONE SpmdAggregateExec stage
+        whose exchange is a psum over the device mesh."""
+        from ballista_tpu.parallel.spmd_stage import SpmdAggregateExec
+        from ballista_tpu.physical.aggregate import AggregateMode, HashAggregateExec
+
+        children = [self._fuse_spmd_aggregates(c) for c in node.children()]
+        if children:
+            node = node.with_children(children)
+        if (
+            isinstance(node, HashAggregateExec)
+            and node.mode == AggregateMode.FINAL
+            and isinstance(node.input, RepartitionExec)
+            and isinstance(node.input.input, HashAggregateExec)
+            and node.input.input.mode == AggregateMode.PARTIAL
+        ):
+            return SpmdAggregateExec(node)
+        return node
 
     def _visit(
         self, node: ExecutionPlan, job_id: str, stages: List[ShuffleWriterExec]

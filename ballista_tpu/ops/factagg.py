@@ -1,0 +1,494 @@
+"""Fact-side aggregation pushdown: Aggregate over a PK-FK join tree.
+
+The reference executes Aggregate(Join(dim, fact)) by materializing the join
+then hash-aggregating the joined rows (DataFusion HashJoinExec +
+HashAggregateExec; serde rust/core/src/serde/physical_plan/from_proto.rs:
+176-214, 370-384). On a relay-attached TPU that shape loses: the join output
+is volatile, so every query pays encode + transfer for 6M+ joined rows.
+
+TPU-first redesign (eager-aggregation + semi-join membership):
+
+  host      dim side of the join (small) executes as-is; its join-key
+            column must be unique (checked) -> the join attaches at most
+            one dim row per fact row, so aggregates distribute over the
+            join. Build a per-rank membership vector over the fact table's
+            cached sorted-key layout.
+  device    ONE jit call over the resident fact layout: fused filters +
+            per-key partial aggregates (ops/stage.py sorted core), mask by
+            membership, and — when the planner annotated a Sort+Limit
+            epilogue — lax.top_k over the score column so the readback is
+            K rows, not G. d2h latency (~65ms) + 28MB/s bandwidth make
+            readback size the whole game.
+  host      attach dim attribute columns to the selected keys, emit the
+            aggregate's partial-state rows; the ordinary Final merge, Sort
+            and Limit operators above run unchanged on K rows.
+
+Pattern matched (q3 shape): HashAggregateExec[single|partial] over
+ [Filter/Projection/Coalesce]* -> HashJoinExec(inner, single equi-key) with
+one side a cacheable file-scan chain (the fact) — fact-side group key must
+be the join key; dim-side group keys are attached post-aggregation; all
+aggregate inputs must be fact-side expressions.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ballista_tpu.ops.runtime import UnsupportedOnDevice
+from ballista_tpu.ops.stage import (
+    FusedAggregateStage,
+    _SCAN_TYPES,
+    decode_packed_rows,
+    jnp_unpack_i32,
+    packed_positions,
+    substitute_columns,
+)
+from ballista_tpu.physical import expr as px
+from ballista_tpu.physical.basic import (
+    CoalesceBatchesExec,
+    FilterExec,
+    ProjectionExec,
+)
+
+# dim sides larger than this are not "dimension tables"; let the host join
+# handle them
+MAX_DIM_ROWS = 4_000_000
+# candidate multiplier for the top-k epilogue: secondary sort keys and f32
+# score ties are resolved host-side within this pool
+TOPK_POOL = 64
+
+
+def _scan_chain_leaf(node):
+    while isinstance(node, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
+        node = node.input
+    return node if isinstance(node, _SCAN_TYPES) else None
+
+
+def _chain_bytes(leaf) -> int:
+    files = getattr(getattr(leaf, "source", None), "files", None)
+    if not files:
+        return 0
+    return sum(os.path.getsize(f) for f in files if os.path.exists(f))
+
+
+def _columns_of(e: px.PhysicalExpr, acc: List[int]) -> None:
+    if isinstance(e, px.ColumnExpr):
+        acc.append(e.index)
+    for name in ("left", "right", "expr", "low", "high", "base", "else_expr"):
+        c = getattr(e, name, None)
+        if isinstance(c, px.PhysicalExpr):
+            _columns_of(c, acc)
+    for a in getattr(e, "args", []) or []:
+        _columns_of(a, acc)
+    for w, t in getattr(e, "when_then", []) or []:
+        _columns_of(w, acc)
+        _columns_of(t, acc)
+
+
+class FactAggregateStage:
+    """Device pipeline for one aggregate-over-join. Built via try_build."""
+
+    @staticmethod
+    def try_build(agg) -> Optional["FactAggregateStage"]:
+        try:
+            return FactAggregateStage(agg)
+        except UnsupportedOnDevice:
+            return None
+
+    def __init__(self, agg) -> None:
+        from ballista_tpu.logical.plan import JoinType
+        from ballista_tpu.physical.aggregate import AggregateFunc, HashAggregateExec
+        from ballista_tpu.physical.join import HashJoinExec
+
+        if agg.mode.value not in ("single", "partial"):
+            raise UnsupportedOnDevice("fact-agg needs single/partial mode")
+
+        # -- walk down to the join ------------------------------------
+        node = agg.input
+        stack: List[Tuple[str, object]] = []
+        while isinstance(node, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
+            if isinstance(node, FilterExec):
+                stack.append(("filter", node.predicate))
+            elif isinstance(node, ProjectionExec):
+                stack.append(("project", node.exprs))
+            node = node.input
+        if not isinstance(node, HashJoinExec) or node.join_type != JoinType.INNER:
+            raise UnsupportedOnDevice("row source is not an inner hash join")
+        if node.filter is not None or len(node.on) != 1:
+            raise UnsupportedOnDevice("join shape (residual filter / multi-key)")
+        join = node
+
+        # -- pick the fact side: the larger cacheable scan chain -------
+        lleaf = _scan_chain_leaf(join.left)
+        rleaf = _scan_chain_leaf(join.right)
+        sides = []
+        if lleaf is not None:
+            sides.append(("left", lleaf, _chain_bytes(lleaf)))
+        if rleaf is not None:
+            sides.append(("right", rleaf, _chain_bytes(rleaf)))
+        sides = [s for s in sides if s[2] > 0]  # fact must be file-backed
+        if not sides:
+            raise UnsupportedOnDevice("no file-backed scan side")
+        fact_side, fact_leaf, _ = max(sides, key=lambda s: s[2])
+        self.fact_plan = join.left if fact_side == "left" else join.right
+        self.dim_plan = join.right if fact_side == "left" else join.left
+        left_n = len(join.left.schema())
+        fact_offset = 0 if fact_side == "left" else left_n
+        fact_n = len(self.fact_plan.schema())
+        lkey, rkey = join.on[0]
+        self.fact_key = lkey if fact_side == "left" else rkey
+        self.dim_key = rkey if fact_side == "left" else lkey
+        fact_key_idx = self.fact_plan.schema().names.index(self.fact_key)
+
+        # -- re-express aggregate exprs over the join schema -----------
+        join_schema = join.schema()
+        mapping: List[px.PhysicalExpr] = [
+            px.ColumnExpr(f.name, i) for i, f in enumerate(join_schema)
+        ]
+        above_filters: List[px.PhysicalExpr] = []
+        for kind, payload in reversed(stack):
+            if kind == "project":
+                mapping = [substitute_columns(e, mapping) for e, _ in payload]
+            else:
+                above_filters.append(substitute_columns(payload, mapping))
+
+        def side_of(e: px.PhysicalExpr) -> str:
+            cols: List[int] = []
+            _columns_of(e, cols)
+            in_fact = [fact_offset <= c < fact_offset + fact_n for c in cols]
+            if all(in_fact):
+                return "fact"
+            if not any(in_fact):
+                return "dim"
+            return "mixed"
+
+        # fact-index remap: join-schema column -> fact-plan column
+        fact_map: List[px.PhysicalExpr] = []
+        for i, f in enumerate(join_schema):
+            if fact_offset <= i < fact_offset + fact_n:
+                fact_map.append(px.ColumnExpr(f.name, i - fact_offset))
+            else:
+                fact_map.append(px.LiteralExpr(None, pa.null()))
+
+        def to_fact(e: px.PhysicalExpr) -> px.PhysicalExpr:
+            return substitute_columns(e, fact_map)
+
+        # group keys: the fact side may contribute only the join key; dim
+        # keys become post-aggregation attachments
+        self.group_layout: List[Tuple[str, Optional[str]]] = []
+        for e, name in [(substitute_columns(e, mapping), n) for e, n in agg.group_exprs]:
+            s = side_of(e)
+            if s == "fact":
+                if not (isinstance(e, px.ColumnExpr) and e.index - fact_offset == fact_key_idx):
+                    raise UnsupportedOnDevice("fact-side group key is not the join key")
+                self.group_layout.append(("factkey", name))
+            elif s == "dim" and isinstance(e, px.ColumnExpr):
+                dim_idx = e.index - (0 if fact_side == "right" else left_n)
+                self.group_layout.append((self.dim_plan.schema().names[dim_idx], name))
+            else:
+                raise UnsupportedOnDevice("unsupported group key shape")
+
+        fact_filters = []
+        for f in above_filters:
+            if side_of(f) != "fact":
+                raise UnsupportedOnDevice("non-fact filter above the join")
+            fact_filters.append(to_fact(f))
+
+        syn_aggs = []
+        for a in agg.aggr_funcs:
+            e = substitute_columns(a.expr, mapping)
+            if side_of(e) not in ("fact",):
+                raise UnsupportedOnDevice("aggregate input not on the fact side")
+            syn_aggs.append(
+                AggregateFunc(a.fn, to_fact(e), a.name, a.dtype, a.input_type)
+            )
+        self.aggs = agg.aggr_funcs
+
+        # -- synthetic partial aggregate over the fact chain -----------
+        from ballista_tpu.physical.aggregate import AggregateMode
+
+        fact_input = self.fact_plan
+        for f in fact_filters:
+            fact_input = FilterExec(fact_input, f)
+        syn = HashAggregateExec(
+            AggregateMode.PARTIAL,
+            fact_input,
+            [(px.ColumnExpr(self.fact_key, fact_key_idx), self.fact_key)],
+            syn_aggs,
+        )
+        self.inner = FusedAggregateStage(syn)
+        # chunk partials must BE group partials (member mask / top-k index
+        # group space); widen L1 to the longest key run
+        self.inner.sorted_cover_max = True
+        if not self.inner.cacheable:
+            raise UnsupportedOnDevice("fact side not cacheable")
+        self.partial_schema = FusedAggregateStage._partial_schema(agg)
+        # planner-provided Sort+Limit epilogue (physical/planner.py)
+        self.topk = getattr(agg, "_topk_pushdown", None)
+        self.partitions = self.fact_plan.output_partitioning().partition_count()
+        if self.topk is not None and (
+            self.partitions != 1
+            or self.aggs[self.topk["agg_index"]].fn != "sum"
+        ):
+            # per-partition partial sums cannot drive a global top-k, and
+            # the score must be a plain SUM state; fall back to the
+            # member-select readback (still correct, larger d2h)
+            self.topk = None
+        self._dim_cache: Optional[dict] = None
+        self._prepared: Dict[int, dict] = {}
+        self._fact_step = None
+
+    # ------------------------------------------------------------------
+    def _score_row(self) -> int:
+        """Logical result-row index of the top-k score column (the j-th
+        aggregate's first state row; row 0 is counts)."""
+        row = 1
+        for a in self.aggs[: self.topk["agg_index"]]:
+            row += len(a.state_fields())
+        return row
+
+    def _build_fact_step(self):
+        import jax
+        import jax.numpy as jnp
+
+        core = self.inner._sorted_core()
+        # positions of each logical result row inside the packed f32 stack
+        # (int32 rows occupy two hi/lo rows, see stage.py::_stack_rows)
+        pos = packed_positions(self.inner._int_rows)
+
+        if self.topk is not None:
+            score_logical = self._score_row()
+            score_row = pos[score_logical]
+            score_is_int = self.inner._int_rows[score_logical]
+            descending = self.topk["descending"]
+            k = min(max(4 * self.topk["k"], TOPK_POOL), 1 << 16)
+
+            def two_stage_topk(masked, kk):
+                """Exact top-k via block maxima: a block holding a true
+                top-k element must rank in the top k blocks by max (k
+                distinct larger elements would otherwise exist). Avoids
+                lax.top_k over the full G (measured ~70ms at G=1.5M; this
+                is ~2ms)."""
+                n = masked.shape[0]
+                B = 128
+                if n < kk * B:
+                    return jax.lax.top_k(masked, kk)
+                npad = -(-n // B) * B
+                m2 = jnp.pad(masked, (0, npad - n),
+                             constant_values=-jnp.inf).reshape(-1, B)
+                bmax = jnp.max(m2, axis=1)
+                _, bidx = jax.lax.top_k(bmax, kk)
+                cand = m2[bidx].reshape(-1)  # [kk * B]
+                vals, ci = jax.lax.top_k(cand, kk)
+                gidx = bidx[ci // B] * B + ci % B
+                return vals, gidx
+
+            @jax.jit
+            def step_topk(cols, aux, pad, member_bits):
+                stacked = core(cols, aux, pad)  # [R_packed, G]
+                G = stacked.shape[1]
+                # little-endian bit unpack (host: np.packbits bitorder="little")
+                bits = (member_bits[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+                member = bits.reshape(-1)[:G]
+                counts = jnp_unpack_i32(stacked[pos[0]], stacked[pos[0] + 1])
+                valid = jnp.logical_and(member > 0, counts > 0)
+                if score_is_int:
+                    # decode BOTH halves — ranking by the hi row alone would
+                    # collapse every sum within a 65536 bucket into a tie
+                    score = jnp_unpack_i32(
+                        stacked[score_row], stacked[score_row + 1]
+                    ).astype(jnp.float32)
+                else:
+                    score = stacked[score_row]
+                if not descending:
+                    score = -score
+                masked = jnp.where(valid, score, -jnp.inf)
+                kk = min(k, G)
+                _, idx = two_stage_topk(masked, kk)
+                sel = jnp.take(stacked, idx, axis=1)
+                # single readback: [R_packed + 3, kk] (d2h latency is ~65ms
+                # per transfer on the relay — never return multiple arrays)
+                return jnp.concatenate(
+                    [
+                        sel,
+                        jnp.take(masked, idx)[None, :],
+                        idx.astype(jnp.float32)[None, :],
+                        jnp.take(valid, idx).astype(jnp.float32)[None, :],
+                    ]
+                )
+
+            return step_topk
+
+        @jax.jit
+        def step_select(cols, aux, pad, positions):
+            stacked = core(cols, aux, pad)
+            return jnp.take(stacked, positions, axis=1)
+
+        return step_select
+
+    # ------------------------------------------------------------------
+    def _dim_side(self, ctx) -> dict:
+        """Execute + cache the dim side; build key->row index."""
+        if self._dim_cache is not None:
+            return self._dim_cache
+        from ballista_tpu.physical.plan import collect_all
+
+        table = collect_all(self.dim_plan, ctx)
+        if table.num_rows > MAX_DIM_ROWS:
+            raise UnsupportedOnDevice("dim side too large")
+        keys = table.column(self.dim_key)
+        if keys.null_count:
+            mask = pc.is_valid(keys)
+            table = table.filter(mask)
+            keys = table.column(self.dim_key)
+        kn = keys.to_numpy(zero_copy_only=False)
+        if len(np.unique(kn)) != len(kn):
+            raise UnsupportedOnDevice("dim join key not unique")
+        order = np.argsort(kn, kind="stable")
+        self._dim_cache = {
+            "table": table,
+            "keys_sorted": kn[order],
+            "order": order,
+        }
+        return self._dim_cache
+
+    def _prepare(self, partition: int, ctx) -> dict:
+        ent = self._prepared.get(partition)
+        if ent is not None:
+            return ent
+        ent = self.inner._prepare_partition_sorted(partition, ctx)
+        if ent["kind"] == "sorted":
+            layout = ent["layout"]
+            if not layout.one_chunk_per_group:
+                raise UnsupportedOnDevice("fact key runs exceed one chunk")
+            kv = ent["key_values"][0]
+            kv_np = (kv.to_numpy(zero_copy_only=False)
+                     if isinstance(kv, (pa.Array, pa.ChunkedArray)) else np.asarray(kv))
+            ent["rank_keys"] = kv_np
+            ent["rank_order"] = np.argsort(kv_np, kind="stable")
+        if self._fact_step is None:
+            self._fact_step = self._build_fact_step()
+        self._prepared[partition] = ent
+        return ent
+
+    # ------------------------------------------------------------------
+    def run(self, partition: int, ctx) -> pa.Table:
+        import jax.numpy as jnp
+
+        dim = self._dim_side(ctx)
+        ent = self._prepare(partition, ctx)
+        if ent["kind"] == "empty" or dim["table"].num_rows == 0:
+            return self.partial_schema.empty_table()
+
+        rank_keys, rank_order = ent["rank_keys"], ent["rank_order"]
+        sorted_keys = rank_keys[rank_order]
+        pos = np.searchsorted(sorted_keys, dim["keys_sorted"])
+        pos = np.clip(pos, 0, len(sorted_keys) - 1)
+        matched = sorted_keys[pos] == dim["keys_sorted"]
+        member_ranks = rank_order[pos[matched]]
+        # dim row index (into the collected dim table) per matched rank
+        dim_rows_for_rank = dim["order"][matched]
+
+        aux = [jnp.asarray(a) for a in self.inner.compiler.build_aux()]
+        G = ent["n_groups"]
+        if self.topk is not None:
+            member = np.zeros(G, dtype=bool)
+            member[member_ranks] = True
+            bits = np.packbits(member, bitorder="little")
+            packed = np.asarray(
+                self._fact_step(ent["cols"], aux, ent["pad"], jnp.asarray(bits))
+            )
+            sel, scores, idx, valid = (
+                packed[:-3],
+                packed[-3],
+                packed[-2].astype(np.int64),
+                packed[-1] > 0,
+            )
+            sel, idx, scores = sel[:, valid], idx[valid], scores[valid]
+            # With secondary sort keys the result is deterministic: if the
+            # candidate-pool boundary sits inside a tie run, groups outside
+            # the pool could legitimately outrank pool members on the
+            # tie-breakers — fall back to the host plan for this query.
+            k = self.topk["k"]
+            if (
+                self.topk.get("strict")
+                and valid.all()
+                and len(scores) > k
+                and scores[min(k - 1, len(scores) - 1)] <= scores[-1]
+            ):
+                raise UnsupportedOnDevice("top-k tie at candidate boundary")
+            # map selected ranks back to dim rows
+            rank_to_dim = np.full(G, -1, dtype=np.int64)
+            rank_to_dim[member_ranks] = dim_rows_for_rank
+            dim_idx = rank_to_dim[idx]
+            return self._assemble(sel, idx, dim_idx, dim["table"], ent)
+        positions = member_ranks.astype(np.int64)
+        if len(positions) == 0:
+            return self.partial_schema.empty_table()
+        sel = np.asarray(
+            self._fact_step(
+                ent["cols"], aux, ent["pad"], jnp.asarray(positions.astype(np.int32))
+            )
+        )
+        rows = self._decode(sel)
+        keep = rows[0] > 0
+        return self._assemble_decoded(
+            [r[keep] for r in rows], positions[keep], dim_rows_for_rank[keep],
+            dim["table"], ent,
+        )
+
+    def _decode(self, stacked: np.ndarray) -> List[np.ndarray]:
+        return [
+            r if r.dtype == np.int64 else r.astype(np.float64)
+            for r in decode_packed_rows(stacked, self.inner._int_rows)
+        ]
+
+    def _assemble(self, sel, ranks, dim_idx, dim_table, ent) -> pa.Table:
+        rows = self._decode(sel)
+        counts = rows[0]
+        keep = counts > 0
+        return self._assemble_decoded(
+            [r[keep] for r in rows], ranks[keep], dim_idx[keep], dim_table, ent
+        )
+
+    def _assemble_decoded(self, rows, ranks, dim_idx, dim_table, ent) -> pa.Table:
+        """Partial-state table for the selected groups: group keys in the
+        original order (fact key value / dim attachments), then states."""
+        counts, states = rows[0], rows[1:]
+        fields = list(self.partial_schema)
+        arrays: List[pa.Array] = []
+        take_dim = pa.array(dim_idx.astype(np.int64))
+        fi = 0
+        for src, _name in self.group_layout:
+            f = fields[fi]
+            if src == "factkey":
+                arr = pa.array(ent["rank_keys"][ranks])
+            else:
+                arr = dim_table.column(src).take(take_dim)
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+            if arr.type != f.type:
+                arr = pc.cast(arr, f.type)
+            arrays.append(arr)
+            fi += 1
+        si = 0
+        nonempty = counts > 0  # all true post-filter; kept for min/max nulls
+        for a in self.aggs:
+            for _ in a.state_fields():
+                f = fields[fi]
+                raw = states[si]
+                if a.fn in ("min", "max"):
+                    arr = pa.array(raw.astype(np.float64), mask=~nonempty)
+                else:
+                    arr = pa.array(raw.astype(np.float64))
+                if arr.type != f.type:
+                    arr = pc.cast(arr, f.type)
+                arrays.append(arr)
+                si += 1
+                fi += 1
+        return pa.table(arrays, schema=self.partial_schema)

@@ -83,11 +83,24 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
                 f"{f}:{os.path.getmtime(f) if os.path.exists(f) else 0}"
                 for f in leaf.source.files
             )
-    key = exec_node.display_indent() + "|" + ",".join(parts)
+    # config flags participate in the key: a run-time decline under one
+    # config must not pin the device path off for another (ADVICE r1). The
+    # top-k annotation does too — it changes what a fact-agg stage returns,
+    # and it is not part of the aggregate subtree's display.
+    flags = (
+        f"fv={ctx.config.tpu_fuse_volatile()},dc={ctx.config.device_cache()},"
+        f"topk={getattr(exec_node, '_topk_pushdown', None)}"
+    )
+    key = exec_node.display_indent() + "|" + ",".join(parts) + "|" + flags
     stage = _stage_cache.get(key)
     if stage is None:
         try:
-            stage = FusedAggregateStage(exec_node)
+            from ballista_tpu.ops.factagg import FactAggregateStage
+
+            # aggregate over a join: try the fact-side pushdown first
+            stage = FactAggregateStage.try_build(exec_node)
+            if stage is None:
+                stage = FusedAggregateStage(exec_node)
         except UnsupportedOnDevice:
             _stage_cache[key] = False
             _stage_cache_pins[key] = pinned

@@ -1,0 +1,117 @@
+"""Chunked segment layout: cardinality-independent grouped aggregation.
+
+The device path's round-1 ceiling was group count: XLA lowers segment_* to
+scatter (serializes on TPU) and unrolled per-group reductions are O(G)
+passes. This module removes the ceiling with a cache-time data layout
+instead of a clever kernel:
+
+  host, once per (partition, group-key set):
+    sort rows by group key, assign dense ranks, split every rank's run of
+    rows into chunks of L1 (L1 = power of two covering the 90th-percentile
+    run length), and materialize the used columns as [V, L1] tiles (zero
+    padded). V = number of chunks; chunks are emitted in rank order, so the
+    chunk->rank "owner" array is sorted.
+
+  device, per query (ONE call, one readback):
+    evaluate filter masks / value expressions elementwise on the [V, L1]
+    tiles, reduce axis 1 -> per-chunk partials [n_out, V]. Pure VPU work,
+    no scatter, no matmul: O(N) regardless of G, and f32 sums reduce in
+    tree order (better than sequential accumulation).
+
+  host, per query:
+    fold chunk partials to groups with np.*.reduceat over the sorted owner
+    array (identity when every rank has one chunk, the common case).
+
+Reference equivalent: the hash-aggregate kernels DataFusion provides under
+HashAggregateExec (rust/core/proto/ballista.proto:370-384); the redesign
+trades their per-row hash table for sorted residency + static shapes, which
+is what XLA/TPU wants.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _chunk_spans(starts: np.ndarray, lens: np.ndarray, L: int):
+    """Split each group's [start, start+len) row range into chunks of <= L
+    rows. Vectorized. Returns (chunk start rows [V], chunk lengths [V],
+    owner group of each chunk [V], all in group order)."""
+    nchunks = np.maximum(-(-lens // L), 1)
+    V = int(nchunks.sum())
+    owner = np.repeat(np.arange(len(lens), dtype=np.int64), nchunks)
+    offs = np.repeat(np.cumsum(nchunks) - nchunks, nchunks)
+    chunk_pos = np.arange(V, dtype=np.int64) - offs
+    cstart = starts[owner] + chunk_pos * L
+    clen = np.clip(lens[owner] - chunk_pos * L, 0, L)
+    return cstart, clen, owner
+
+
+class SortedSegmentLayout:
+    """Host-side artifact built once per partition per group-key set."""
+
+    def __init__(self, codes: np.ndarray, n_groups: int,
+                 cover_max: bool = False) -> None:
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        grid = np.arange(n_groups, dtype=np.int64)
+        starts = np.searchsorted(sorted_codes, grid)
+        ends = np.searchsorted(sorted_codes, grid, side="right")
+        lens = ends - starts
+
+        # cover_max: one chunk per group whenever the longest run fits 1024
+        # (fact-agg needs chunk partials == group partials); default: cover
+        # the 90th percentile and let fold_* handle the tail
+        target = int(lens.max()) if (cover_max and n_groups) else (
+            int(np.percentile(lens, 90)) if n_groups else 1
+        )
+        L1 = 8
+        while L1 < target and L1 < 1024:
+            L1 <<= 1
+        cstart, clen, owner = _chunk_spans(starts, lens, L1)
+
+        V = len(owner)
+        idx = cstart[:, None] + np.arange(L1, dtype=np.int64)[None, :]
+        pad = np.arange(L1, dtype=np.int64)[None, :] < clen[:, None]
+        idx = np.where(pad, idx, 0)
+
+        self.n_groups = n_groups
+        self.L1 = L1
+        self.V = V
+        # take-index into ORIGINAL row positions
+        self.row_take = order[idx.reshape(-1)].reshape(V, L1)
+        self.pad = pad  # bool [V, L1]
+        self.owner = owner  # sorted [V]
+        self.one_chunk_per_group = V == n_groups
+        if not self.one_chunk_per_group:
+            self._fold_starts = np.searchsorted(owner, grid)
+
+    # ------------------------------------------------------------------
+    def materialize(self, col: np.ndarray) -> np.ndarray:
+        """Lay a row-space column out as [V, L1] tiles (pad slots carry row
+        0's value; every consumer masks with .pad)."""
+        return col[self.row_take.reshape(-1)].reshape(self.V, self.L1)
+
+    # ------------------------------------------------------------------
+    def fold_sum(self, chunk_partials: np.ndarray) -> np.ndarray:
+        if self.one_chunk_per_group:
+            return chunk_partials
+        # widen before folding: float for accuracy, int so exact chunk sums
+        # stay exact across groups of any size
+        if chunk_partials.dtype == np.float32:
+            cp = chunk_partials.astype(np.float64)
+        elif chunk_partials.dtype == np.int32:
+            cp = chunk_partials.astype(np.int64)
+        else:
+            cp = chunk_partials
+        return np.add.reduceat(cp, self._fold_starts)
+
+    def fold_min(self, chunk_partials: np.ndarray) -> np.ndarray:
+        if self.one_chunk_per_group:
+            return chunk_partials
+        return np.minimum.reduceat(chunk_partials, self._fold_starts)
+
+    def fold_max(self, chunk_partials: np.ndarray) -> np.ndarray:
+        if self.one_chunk_per_group:
+            return chunk_partials
+        return np.maximum.reduceat(chunk_partials, self._fold_starts)

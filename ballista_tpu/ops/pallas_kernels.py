@@ -80,6 +80,130 @@ def _build(num_groups: int, n_values: int, interpret: bool):
     return run
 
 
+SORT_BLOCK = 1024
+_LANE = 128
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sorted(n_values_padded: int, block: int, interpret: bool):
+    """Sorted-rank grouped sum: rows are pre-sorted by group key and codes are
+    DENSE ranks (consecutive distinct keys differ by exactly 1), so every
+    block of B rows spans a rank window of at most B. Each grid step:
+
+        local[v, w] = sum_b vals[v, b] * (codes[b] - base == w)
+
+    — one [AV, B] @ [B, W] one-hot matmul on the MXU — accumulated into the
+    HBM output at dynamic offset `base` via a read-modify-write DMA of the
+    [AV, W] window. Cost is O(N * B) regardless of the total group count:
+    this is what removes the device path's group-cardinality ceiling
+    (reference hash aggregate: rust/core/proto/ballista.proto:370-384).
+
+    Precision: one-hot entries are exact in bf16; HIGHEST precision keeps
+    value products at effectively f32, accumulation is f32 adds.
+
+    Status: measured ~107ms for 6M rows on v5e (MXU utilization is capped by
+    the skinny value dimension, and the RMW DMA serializes the grid). The
+    chunked-segment layout (ops/layout.py + stage._sorted_core) does the
+    same job in ~0.15ms of device time and is the production path;
+    dev/probe_sorted.py keeps this kernel honest as the MXU alternative.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = block
+    # window start is aligned down to the 128-lane tile so the dynamic DMA
+    # offset is provably tile-divisible for Mosaic; the extra lane covers the
+    # alignment slack, one more covers the in-block rank growth
+    W = B + 2 * _LANE
+    AV = n_values_padded
+
+    def kernel(bases_ref, codes_ref, vals_ref, init_ref, out_ref,
+               acc_ref, sem_in, sem_out):
+        i = pl.program_id(0)
+        base = (bases_ref[i] // _LANE) * _LANE
+        window = out_ref.at[:, pl.ds(base, W)]
+        copy_in = pltpu.make_async_copy(window, acc_ref, sem_in)
+        copy_in.start()
+        local = (codes_ref[:] - base)[None, :]
+        onehot = (
+            local == jax.lax.broadcasted_iota(jnp.int32, (W, 1), 0)
+        ).astype(jnp.float32)  # [W, B]
+        prod = jax.lax.dot_general(
+            vals_ref[:], onehot,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )  # [AV, W]
+        copy_in.wait()
+        acc_ref[:] += prod
+        copy_out = pltpu.make_async_copy(acc_ref, window, sem_out)
+        copy_out.start()
+        copy_out.wait()
+
+    @jax.jit
+    def run(bases, codes, vals, init):
+        nb = codes.shape[0] // B
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((B,), lambda i, bases: (i,)),
+                pl.BlockSpec((AV, B), lambda i, bases: (0, i)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((AV, W), jnp.float32),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+            ],
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(init.shape, jnp.float32),
+            input_output_aliases={3: 0},
+            interpret=interpret,
+        )(bases, codes, vals, init)
+
+    return run
+
+
+def sorted_grouped_sum(
+    codes,
+    values,
+    num_groups: int,
+    interpret: Optional[bool] = None,
+):
+    """Device arrays in, device array out: out[v, g] = sum of values[v, i]
+    where codes[i] == g. codes must be sorted dense ranks (int32); values
+    rows are pre-masked (a count output is just a mask row). Returns a
+    device array [n_values, num_groups]; pure jit-compatible pieces, one
+    pallas_call.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    nv, n = values.shape
+    assert codes.shape == (n,)
+    B = SORT_BLOCK
+    assert n % B == 0, "pad rows to SORT_BLOCK host-side"
+    AV = max(8, -(-nv // 8) * 8)  # sublane-pad the value dim
+    if AV != nv:
+        values = jnp.concatenate(
+            [values, jnp.zeros((AV - nv, n), jnp.float32)], axis=0
+        )
+    bases = codes[::B]
+    gpad = num_groups + B + 2 * _LANE
+    init = jnp.zeros((AV, gpad), jnp.float32)
+    out = _build_sorted(AV, B, interpret)(bases, codes, values, init)
+    return out[:nv, :num_groups]
+
+
 def grouped_aggregate(
     codes: np.ndarray,
     values: np.ndarray,

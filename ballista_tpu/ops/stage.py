@@ -39,9 +39,57 @@ from ballista_tpu.physical.scan import CsvScanExec, MemoryScanExec, ParquetScanE
 
 _SCAN_TYPES = (CsvScanExec, ParquetScanExec, MemoryScanExec)
 
-# the device path aggregates with G unrolled reductions; beyond this the host
-# hash aggregate wins (XLA segment_* scatter serializes on TPU)
+# ceiling for the per-batch unrolled path (G linear passes); beyond it the
+# stage switches to the sorted chunked-segment layout (ops/layout.py), which
+# is O(N) regardless of group count
 MAX_GROUPS = 1024
+
+_INT32_MAX = 2**31 - 1
+
+
+class TooManyGroups(UnsupportedOnDevice):
+    """Internal signal: per-batch unrolled path declined on cardinality;
+    run() retries with the sorted layout before giving up."""
+
+
+# --- int32 <-> f32-pair packing -------------------------------------------
+# Bitcasting int32 to f32 is NOT safe on TPU (small ints are denormal floats
+# and get flushed to zero), so int rows travel as two exactly-representable
+# halves: hi = v >> 16 (arithmetic), lo = v & 0xFFFF. Encode lives in
+# _stack_rows; BOTH decoders below must mirror it.
+
+
+def decode_packed_rows(stacked: np.ndarray, int_rows) -> List[np.ndarray]:
+    """Host-side decode of a packed [R_packed, ...] f32 result: int rows
+    come back as int64, float rows as the f32 slices."""
+    rows: List[np.ndarray] = []
+    i = 0
+    for is_int in int_rows:
+        if is_int:
+            hi = stacked[i].astype(np.int64)
+            lo = stacked[i + 1].astype(np.int64)
+            rows.append(hi * 65536 + lo)
+            i += 2
+        else:
+            rows.append(stacked[i])
+            i += 1
+    return rows
+
+
+def packed_positions(int_rows) -> List[int]:
+    """Position of each logical row inside the packed stack."""
+    pos, p = [], 0
+    for is_int in int_rows:
+        pos.append(p)
+        p += 2 if is_int else 1
+    return pos
+
+
+def jnp_unpack_i32(hi, lo):
+    """In-program decode (exact int32)."""
+    import jax.numpy as jnp
+
+    return hi.astype(jnp.int32) * 65536 + lo.astype(jnp.int32)
 
 
 def substitute_columns(e: px.PhysicalExpr, mapping: List[px.PhysicalExpr]) -> px.PhysicalExpr:
@@ -145,18 +193,30 @@ class FusedAggregateStage:
             if f.kind != "bool":
                 raise UnsupportedOnDevice("non-boolean filter")
         self.value_fns = []
+        # integer-typed plain-column inputs accumulate in int32 on device
+        # (exact, vs the f32 rounding ADVICE r1 flagged); the value range is
+        # bound-checked at prepare time and declines when int32 could
+        # overflow a whole-batch masked sum
+        self.int_exact: List[bool] = []
         for a, ie in zip(self.aggs, self.agg_inputs):
             if a.fn == "count":
                 self.value_fns.append(None)  # mask count only
+                self.int_exact.append(False)
             else:
                 cv = self.compiler.compile(ie)
                 if cv.kind == "code":
                     raise UnsupportedOnDevice("string aggregate input")
                 self.value_fns.append(cv)
+                self.int_exact.append(
+                    isinstance(ie, px.ColumnExpr)
+                    and pa.types.is_integer(scan_schema.field(ie.index).type)
+                )
         self.scan_schema = scan_schema
         self.partial_schema = agg.schema() if agg.mode.value == "partial" else self._partial_schema(agg)
+        self._int_rows, self._folds = self._plan_outputs()
         self._step = self._build_step()
-        self._device_cache: Dict[int, List[dict]] = {}
+        self._sorted_step = None  # built on first high-cardinality partition
+        self._device_cache: Dict[int, dict] = {}
 
     @staticmethod
     def _partial_schema(agg) -> pa.Schema:
@@ -168,40 +228,86 @@ class FusedAggregateStage:
         return pa.schema(group_fields + state_fields)
 
     # ------------------------------------------------------------------
+    def _plan_outputs(self):
+        """Stacked-output plan shared by both device steps: row 0 is counts,
+        then one row per aggregate state column. Returns (is_int flags,
+        fold op names) per stacked row."""
+        int_rows = [True]  # counts
+        folds = ["sum"]
+        for a, ix in zip(self.aggs, self.int_exact):
+            if a.fn == "count":
+                int_rows.append(True)
+                folds.append("sum")
+            elif a.fn in ("sum", "avg"):
+                int_rows.append(ix)
+                folds.append("sum")
+                if a.fn == "avg":
+                    int_rows.append(True)
+                    folds.append("sum")
+            else:  # min / max
+                int_rows.append(ix)
+                folds.append(a.fn)
+        return int_rows, folds
+
+    def _stack_rows(self, rows):
+        """Pack mixed int32/f32 result rows into ONE f32 array -> ONE
+        device->host transfer (d2h latency dominates on relay-attached
+        chips). Bitcasting int32 to f32 is NOT safe on TPU — small ints are
+        denormal floats and get flushed to zero — so each int32 row is split
+        into two exactly-f32-representable halves (arithmetic-shift hi,
+        unsigned lo); _decode_stacked recombines."""
+        import jax.numpy as jnp
+
+        out = []
+        for r in rows:
+            if r.dtype == jnp.int32:
+                out.append((r >> 16).astype(jnp.float32))
+                out.append((r & 0xFFFF).astype(jnp.float32))
+            else:
+                out.append(r)
+        return jnp.stack(out)
+
     def _build_step(self):
         import jax
+
+        return functools.partial(jax.jit, static_argnums=(0,))(
+            self._unrolled_core()
+        )
+
+    def _unrolled_core(self):
+        """Unjitted per-batch unrolled-reduction program; SpmdAggregateExec
+        wraps it in shard_map + psum for the mesh path."""
         import jax.numpy as jnp
 
         filter_fns = self.filter_fns
         value_fns = self.value_fns
         aggs = self.aggs
+        int_exact = self.int_exact
 
         # XLA lowers segment_* to scatter, which serializes on TPU (measured
-        # 460ms vs ~5ms for 6M rows). Group counts are capped at MAX_GROUPS (run())
+        # 460ms vs ~5ms for 6M rows). Group counts are capped at MAX_GROUPS
         # by run(), so every aggregation is an unrolled per-group masked
         # reduction: pure HBM-bandwidth work on the VPU, G linear passes,
-        # each a tree reduction (pairwise-summation accuracy).
+        # each a tree reduction (pairwise-summation accuracy). Integer sums
+        # accumulate in int32 (exact; range-checked at prepare time).
 
-        def seg_sum(v, safe_codes, num_segments):
+        def seg_sum(v, safe_codes, num_segments, zero):
             return jnp.stack(
                 [
-                    jnp.sum(jnp.where(safe_codes == g, v, 0.0))
+                    jnp.sum(jnp.where(safe_codes == g, v, zero))
                     for g in range(num_segments)
                 ]
             )
 
         def seg_count(safe_codes, num_segments):
-            # int32 counts: exact where f32 loses exactness at 2^24
             return jnp.stack(
                 [
                     jnp.sum(jnp.where(safe_codes == g, 1, 0), dtype=jnp.int32)
                     for g in range(num_segments)
                 ]
-            ).astype(jnp.float32)
+            )
 
-        def seg_extreme(v, safe_codes, num_segments, largest):
-            fill = -jnp.inf if largest else jnp.inf
-            red = jnp.max if largest else jnp.min
+        def seg_extreme(v, safe_codes, num_segments, fill, red):
             return jnp.stack(
                 [
                     red(jnp.where(safe_codes == g, v, fill))
@@ -209,34 +315,99 @@ class FusedAggregateStage:
                 ]
             )
 
-        @functools.partial(jax.jit, static_argnums=(0,))
         def step(num_segments, cols, aux, codes, row_valid):
             mask = row_valid
             for f in filter_fns:
                 mask = jnp.logical_and(mask, f.fn(cols, aux))
             maskf = mask.astype(jnp.float32)
             safe_codes = jnp.where(mask, codes, num_segments - 1)
-            outputs = []
             counts = seg_count(safe_codes, num_segments)
-            for a, vf in zip(aggs, value_fns):
+            rows = [counts]
+            for a, vf, ix in zip(aggs, value_fns, int_exact):
                 if a.fn == "count":
-                    outputs.append(counts)
+                    rows.append(counts)
                     continue
-                v = vf.fn(cols, aux).astype(jnp.float32)
+                v = vf.fn(cols, aux)
                 v = jnp.broadcast_to(v, mask.shape)
                 if a.fn in ("sum", "avg"):
-                    outputs.append(seg_sum(v * maskf, safe_codes, num_segments))
+                    if ix:
+                        vi = jnp.where(mask, v.astype(jnp.int32), 0)
+                        rows.append(seg_sum(vi, safe_codes, num_segments, 0))
+                    else:
+                        rows.append(
+                            seg_sum(v.astype(jnp.float32) * maskf, safe_codes,
+                                    num_segments, 0.0)
+                        )
                     if a.fn == "avg":
-                        outputs.append(counts)
-                elif a.fn == "min":
-                    outputs.append(seg_extreme(v, safe_codes, num_segments, False))
-                elif a.fn == "max":
-                    outputs.append(seg_extreme(v, safe_codes, num_segments, True))
-            # one stacked result -> ONE device->host transfer per batch
-            # (d2h latency dominates on relay-attached chips)
-            return jnp.stack([counts] + outputs)
+                        rows.append(counts)
+                elif a.fn in ("min", "max"):
+                    largest = a.fn == "max"
+                    if ix:
+                        fill = -_INT32_MAX - 1 if largest else _INT32_MAX
+                        v2 = jnp.where(mask, v.astype(jnp.int32), fill)
+                    else:
+                        fill = -jnp.inf if largest else jnp.inf
+                        v2 = jnp.where(mask, v.astype(jnp.float32), fill)
+                    rows.append(
+                        seg_extreme(v2, safe_codes, num_segments, fill,
+                                    jnp.max if largest else jnp.min)
+                    )
+            return self._stack_rows(rows)
 
         return step
+
+    def _build_sorted_step(self):
+        import jax
+
+        return jax.jit(self._sorted_core())
+
+    def _sorted_core(self):
+        """Unjitted device program for the chunked-segment layout
+        (ops/layout.py): elementwise exprs over [V, L1] tiles, axis-1
+        reductions to per-chunk partials. O(N) for any group count.
+        FactAggregateStage composes this with a membership/top-k epilogue
+        inside one jit."""
+        import jax.numpy as jnp
+
+        filter_fns = self.filter_fns
+        value_fns = self.value_fns
+        aggs = self.aggs
+        int_exact = self.int_exact
+
+        def sstep(cols, aux, pad):
+            mask = pad
+            for f in filter_fns:
+                mask = jnp.logical_and(mask, f.fn(cols, aux))
+            maskf = mask.astype(jnp.float32)
+            counts = jnp.sum(mask, axis=1, dtype=jnp.int32)
+            rows = [counts]
+            for a, vf, ix in zip(aggs, value_fns, int_exact):
+                if a.fn == "count":
+                    rows.append(counts)
+                    continue
+                v = vf.fn(cols, aux)
+                v = jnp.broadcast_to(v, mask.shape)
+                if a.fn in ("sum", "avg"):
+                    if ix:
+                        rows.append(
+                            jnp.sum(jnp.where(mask, v.astype(jnp.int32), 0), axis=1)
+                        )
+                    else:
+                        rows.append(jnp.sum(v.astype(jnp.float32) * maskf, axis=1))
+                    if a.fn == "avg":
+                        rows.append(counts)
+                elif a.fn in ("min", "max"):
+                    largest = a.fn == "max"
+                    if ix:
+                        fill = -_INT32_MAX - 1 if largest else _INT32_MAX
+                        v2 = jnp.where(mask, v.astype(jnp.int32), fill)
+                    else:
+                        fill = -jnp.inf if largest else jnp.inf
+                        v2 = jnp.where(mask, v.astype(jnp.float32), fill)
+                    rows.append((jnp.max if largest else jnp.min)(v2, axis=1))
+            return self._stack_rows(rows)
+
+        return sstep
 
     # ------------------------------------------------------------------
     def _group_codes(self, batch: pa.RecordBatch) -> Tuple[np.ndarray, List[pa.Array], int]:
@@ -334,6 +505,29 @@ class FusedAggregateStage:
             return
         yield from self.scan.execute(partition, ctx)
 
+    def _check_int_ranges(self, batch_cols: Dict[int, np.ndarray], n: int) -> None:
+        """Integer sums accumulate in int32 on device; decline when a
+        whole-batch masked sum could overflow (ADVICE r1: silent f32
+        rounding of integer aggregates)."""
+        for a, ie, ix in zip(self.aggs, self.agg_inputs, self.int_exact):
+            if not ix or a.fn not in ("sum", "avg"):
+                continue
+            npcol = batch_cols.get(ie.index)
+            if npcol is None or len(npcol) == 0:
+                continue
+            bound = max(abs(int(npcol.max())), abs(int(npcol.min()))) * n
+            if bound > _INT32_MAX:
+                raise UnsupportedOnDevice(
+                    f"int32 sum over column {ie.name!r} may overflow"
+                )
+
+    def _lower_columns(self, batch: pa.RecordBatch) -> Dict[int, np.ndarray]:
+        cols: Dict[int, np.ndarray] = {}
+        for idx, dtype in self.compiler.used_columns.items():
+            d = self.dicts.dicts.get(idx)
+            cols[idx] = column_to_numpy(batch.column(idx), dtype, d)
+        return cols
+
     def _prepare_partition(self, partition: int, ctx) -> List[dict]:
         """Host work for one partition: scan, encode, pad, transfer. Returns
         per-batch device-input entries (jnp column arrays stay resident)."""
@@ -345,20 +539,19 @@ class FusedAggregateStage:
                 continue
             n = batch.num_rows
             bucket = bucket_rows(n)
-            # group codes FIRST: a high-cardinality decline must not pay the
+            # group codes FIRST: a high-cardinality switch must not pay the
             # column upload
             codes, key_values, n_groups = self._group_codes(batch)
             if n_groups == 0:
                 continue
             if n_groups > MAX_GROUPS:
-                # high-cardinality group-by: XLA's scatter lowering loses to
-                # the host hash aggregate — decline the whole stage
-                raise UnsupportedOnDevice(f"{n_groups} groups exceeds device path")
+                # beyond the unrolled path's ceiling: run() retries with the
+                # sorted chunked-segment layout
+                raise TooManyGroups(f"{n_groups} groups exceeds unrolled path")
+            npcols = self._lower_columns(batch)
+            self._check_int_ranges(npcols, n)
             cols: Dict[int, object] = {}
-            for idx, dtype in self.compiler.used_columns.items():
-                arr = batch.column(idx)
-                d = self.dicts.dicts.get(idx)
-                npcol = column_to_numpy(arr, dtype, d)
+            for idx, npcol in npcols.items():
                 fill = False if npcol.dtype == np.bool_ else 0
                 cols[idx] = jnp.asarray(pad_to(npcol, bucket, fill))
             seg_bucket = bucket_rows(n_groups, 16) + 1  # +1 dump slot
@@ -377,6 +570,41 @@ class FusedAggregateStage:
             )
         return entries
 
+    def _prepare_partition_sorted(self, partition: int, ctx) -> dict:
+        """High-cardinality path: whole-partition chunked-segment layout
+        (ops/layout.py). Sorting/ranking/materialization is cache-time host
+        work; per-query device work is O(N) elementwise + axis reductions."""
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.layout import SortedSegmentLayout
+
+        batches = [b for b in self._scan_batches(partition, ctx) if b.num_rows]
+        if not batches:
+            return {"kind": "empty"}
+        table = pa.Table.from_batches(batches).combine_chunks()
+        batch = table.to_batches(max_chunksize=table.num_rows)[0]
+        codes, key_values, n_groups = self._group_codes(batch)
+        if n_groups == 0:
+            return {"kind": "empty"}
+        layout = SortedSegmentLayout(
+            codes, n_groups, cover_max=getattr(self, "sorted_cover_max", False)
+        )
+        npcols = self._lower_columns(batch)
+        self._check_int_ranges(npcols, layout.L1)
+        cols: Dict[int, object] = {}
+        for idx, npcol in npcols.items():
+            cols[idx] = jnp.asarray(layout.materialize(npcol))
+        if self._sorted_step is None:
+            self._sorted_step = self._build_sorted_step()
+        return {
+            "kind": "sorted",
+            "layout": layout,
+            "cols": cols,
+            "pad": jnp.asarray(layout.pad),
+            "key_values": key_values,
+            "n_groups": n_groups,
+        }
+
     def run(self, partition: int, ctx) -> Optional[pa.Table]:
         import jax.numpy as jnp
 
@@ -386,17 +614,26 @@ class FusedAggregateStage:
             # encode+transfer per query with no residency payoff — measured a
             # wash-to-loss on relay-attached chips, so it is opt-in
             raise UnsupportedOnDevice("volatile row source (enable ballista.tpu.fuse_volatile_sources)")
-        entries = self._device_cache.get(partition) if use_cache else None
-        if entries is None:
-            entries = self._prepare_partition(partition, ctx)
+        prepared = self._device_cache.get(partition) if use_cache else None
+        if prepared is None:
+            try:
+                prepared = {"kind": "batches",
+                            "entries": self._prepare_partition(partition, ctx)}
+            except TooManyGroups:
+                prepared = self._prepare_partition_sorted(partition, ctx)
             if use_cache:
-                self._device_cache[partition] = entries
+                self._device_cache[partition] = prepared
+
+        aux = [jnp.asarray(a) for a in self.compiler.build_aux()]
+        if prepared["kind"] == "empty":
+            return self.partial_schema.empty_table()
+        if prepared["kind"] == "sorted":
+            return self._run_sorted(prepared, aux)
 
         # dispatch all batches asynchronously, then materialize — device
         # compute and d2h of batch i overlap dispatch of batch i+1
-        aux = [jnp.asarray(a) for a in self.compiler.build_aux()]
         pending = []
-        for ent in entries:
+        for ent in prepared["entries"]:
             stacked_dev = self._step(
                 ent["seg_bucket"], ent["cols"], aux, ent["codes"], ent["row_valid"]
             )
@@ -404,16 +641,32 @@ class FusedAggregateStage:
 
         partial_tables: List[pa.Table] = []
         for stacked_dev, ent in pending:
-            stacked = np.asarray(stacked_dev)
+            rows = self._decode_stacked(np.asarray(stacked_dev))
             n_groups = ent["n_groups"]
-            counts_np = stacked[0][:n_groups]
-            outputs = [o[:n_groups] for o in stacked[1:]]
+            counts_np = rows[0][:n_groups]
+            outputs = [o[:n_groups] for o in rows[1:]]
             t = self._assemble_partial(outputs, counts_np, ent["key_values"], n_groups)
             if t.num_rows:
                 partial_tables.append(t)
         if not partial_tables:
             return self.partial_schema.empty_table()
         return pa.concat_tables(partial_tables)
+
+    def _decode_stacked(self, stacked: np.ndarray) -> List[np.ndarray]:
+        """Undo _stack_rows' int32 hi/lo packing."""
+        return decode_packed_rows(stacked, self._int_rows)
+
+    def _run_sorted(self, ent: dict, aux) -> pa.Table:
+        layout = ent["layout"]
+        stacked = np.asarray(self._sorted_step(ent["cols"], aux, ent["pad"]))
+        rows = self._decode_stacked(stacked)
+        folds = {"sum": layout.fold_sum, "min": layout.fold_min,
+                 "max": layout.fold_max}
+        counts = layout.fold_sum(rows[0])
+        outputs = [folds[f](r) for f, r in zip(self._folds[1:], rows[1:])]
+        return self._assemble_partial(
+            outputs, counts, ent["key_values"], ent["n_groups"]
+        )
 
     def _assemble_partial(
         self,

@@ -1,0 +1,240 @@
+"""SPMD aggregation stage: Partial -> exchange -> Final as ONE mesh program.
+
+The reference executes a distributed aggregation as independent
+per-partition partial tasks, a materialized hash shuffle, and final tasks
+(rust/scheduler/src/planner.rs:149-171 + the ShuffleWriter/Reader pair).
+The TPU-native restructuring (SURVEY §2.8, §7 step 5): partitions map to
+shards of a jax.sharding.Mesh, the partial phase is the fused-stage program
+on each shard, and the exchange is lax.psum over the mesh's ICI — no
+materialize-then-fetch, one XLA program for the whole
+Partial->shuffle->Final pipeline.
+
+SpmdAggregateExec is emitted by the DistributedPlanner (config
+`ballista.tpu.spmd` = true) in place of the
+HashAggregate(Final) <- Repartition(hash) <- HashAggregate(Partial)
+subtree, collapsing what would be two stages + a shuffle into one stage.
+The per-shard program is driven by FusedAggregateStage's compiled
+filter/value functions — the same expression compiler the single-chip
+backend uses — not a hand-written kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.physical.plan import (
+    ExecutionPlan,
+    Partitioning,
+    TaskContext,
+    batch_table,
+    collect_all,
+)
+
+
+class SpmdAggregateExec(ExecutionPlan):
+    """Executes Final(Repartition(Partial(input))) as one mesh program.
+
+    Falls back to executing the wrapped subplan on the host when the mesh
+    can't be built or the stage doesn't lower (high cardinality, exprs the
+    device path declines, non-TPU backend) — the wrapped subplan is the
+    untouched original subtree, so behavior is identical minus the fusion.
+    """
+
+    def __init__(self, subplan: ExecutionPlan) -> None:
+        # subplan = HashAggregateExec(FINAL) over RepartitionExec over
+        # HashAggregateExec(PARTIAL); kept whole for serde + fallback
+        from ballista_tpu.physical.aggregate import AggregateMode, HashAggregateExec
+        from ballista_tpu.physical.repartition import RepartitionExec
+
+        assert isinstance(subplan, HashAggregateExec)
+        assert subplan.mode == AggregateMode.FINAL
+        self.subplan = subplan
+        repart = subplan.input
+        assert isinstance(repart, RepartitionExec)
+        partial = repart.input
+        assert isinstance(partial, HashAggregateExec)
+        assert partial.mode == AggregateMode.PARTIAL
+        self.final = subplan
+        self.partial = partial
+        self._stage = None
+        self._mesh = None
+        self._program = None
+        self._program_key = None
+        # introspection: "mesh" or "host" after each execute (the dryrun and
+        # tests assert the mesh path actually ran, since the host fallback
+        # produces identical results)
+        self.last_path: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def schema(self) -> pa.Schema:
+        return self.subplan.schema()
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(1)
+
+    def children(self) -> List[ExecutionPlan]:
+        # the subplan is serialized/traversed whole; no planner recursion
+        # into it (it must stay one stage)
+        return []
+
+    def with_children(self, children: List[ExecutionPlan]) -> "SpmdAggregateExec":
+        assert not children
+        return self
+
+    def fmt(self) -> str:
+        return "SpmdAggregateExec: partial+exchange+final as one mesh program"
+
+    # ------------------------------------------------------------------
+    def _build_mesh(self, ctx: TaskContext):
+        from ballista_tpu.parallel.mesh import build_mesh
+
+        import jax
+
+        if self._mesh is not None:
+            return self._mesh
+        shape = ctx.config.mesh_shape() or None
+        try:
+            self._mesh = build_mesh(shape)
+        except ValueError:
+            # fewer devices than the configured mesh: use all local devices
+            self._mesh = build_mesh({"data": len(jax.devices())})
+        return self._mesh
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        assert partition == 0
+        if ctx.backend != "tpu":
+            yield from self.subplan.execute(partition, ctx)
+            return
+        try:
+            out = self._execute_mesh(ctx)
+            self.last_path = "mesh"
+        except Exception:  # device decline of any kind -> host subplan
+            from ballista_tpu.ops.runtime import UnsupportedOnDevice
+            import logging
+            import sys
+
+            exc = sys.exc_info()[1]
+            if not isinstance(exc, UnsupportedOnDevice):
+                logging.getLogger("ballista.spmd").warning(
+                    "mesh aggregation failed, host fallback: %s", exc
+                )
+            self.last_path = "host"
+            yield from self.subplan.execute(partition, ctx)
+            return
+        yield from batch_table(out, ctx.batch_size)
+
+    # ------------------------------------------------------------------
+    def _execute_mesh(self, ctx: TaskContext) -> pa.Table:
+        import jax
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.runtime import UnsupportedOnDevice, bucket_rows, pad_to
+        from ballista_tpu.ops.stage import FusedAggregateStage, MAX_GROUPS
+
+        if self._stage is None:
+            self._stage = FusedAggregateStage(self.partial)
+        stage = self._stage
+        mesh = self._build_mesh(ctx)
+        n_dev = int(np.prod(list(mesh.shape.values())))
+
+        # host: read every input partition, compute GLOBAL group codes so a
+        # group id means the same thing on every shard
+        parts = stage.scan.output_partitioning().partition_count()
+        batches = []
+        for p in range(parts):
+            batches.extend(b for b in stage._scan_batches(p, ctx) if b.num_rows)
+        if not batches:
+            return self.schema().empty_table()
+        table = pa.Table.from_batches(batches).combine_chunks()
+        batch = table.to_batches(max_chunksize=table.num_rows)[0]
+        codes, key_values, n_groups = stage._group_codes(batch)
+        if n_groups == 0:
+            return self.schema().empty_table()
+        if n_groups > MAX_GROUPS:
+            raise UnsupportedOnDevice("mesh path uses unrolled reductions")
+        npcols = stage._lower_columns(batch)
+        stage._check_int_ranges(npcols, batch.num_rows)
+
+        # shard rows across the mesh: equal-size padded shards
+        n = batch.num_rows
+        shard = bucket_rows(-(-n // n_dev))
+        total = shard * n_dev
+        cols: Dict[int, object] = {}
+        for idx, npcol in npcols.items():
+            fill = False if npcol.dtype == np.bool_ else 0
+            cols[idx] = jnp.asarray(pad_to(npcol, total, fill))
+        codes_pad = jnp.asarray(pad_to(codes.astype(np.int32), total, 0))
+        row_valid = np.zeros(total, dtype=np.bool_)
+        row_valid[:n] = True
+        row_valid = jnp.asarray(row_valid)
+        aux = [jnp.asarray(a) for a in stage.compiler.build_aux()]
+
+        seg = int(bucket_rows(n_groups, 16)) + 1  # +1 dump slot
+        program = self._get_program(mesh, stage, seg, set(cols.keys()), len(aux))
+        stacked = np.asarray(program(cols, aux, codes_pad, row_valid))
+
+        rows = stage._decode_stacked(stacked)
+        counts = rows[0][:n_groups]
+        outputs = [r[:n_groups] for r in rows[1:]]
+        partial_table = stage._assemble_partial(outputs, counts, key_values, n_groups)
+        return self.final._final(partial_table)
+
+    def _get_program(self, mesh, stage, seg: int, col_keys, n_aux: int):
+        """shard_map(per-shard fused partials) + psum, jitted once per
+        (segment bucket, column set); the mesh is built once per exec."""
+        key = (seg, tuple(sorted(col_keys)), n_aux)
+        if self._program_key == key:
+            return self._program
+
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ballista_tpu.ops.stage import jnp_unpack_i32
+
+        core = stage._unrolled_core()
+        int_rows = stage._int_rows
+        folds = stage._folds
+        collectives = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                       "max": jax.lax.pmax}
+
+        def per_shard(cols, aux, codes, row_valid):
+            stacked = core(seg, cols, aux, codes, row_valid)
+            # the exchange: merge shard partials over ICI instead of a
+            # materialized hash shuffle. Rows reduce with their own
+            # collective (sum/min/max); int32 rows are hi/lo packed (see
+            # stage.py::_stack_rows), so decode -> exact int32 collective
+            # -> re-encode.
+            outs = []
+            p = 0
+            for is_int, fold in zip(int_rows, folds):
+                red = collectives[fold]
+                if is_int:
+                    v = red(jnp_unpack_i32(stacked[p], stacked[p + 1]), "data")
+                    outs.append((v >> 16).astype(jnp.float32))
+                    outs.append((v & 0xFFFF).astype(jnp.float32))
+                    p += 2
+                else:
+                    outs.append(red(stacked[p], "data"))
+                    p += 1
+            return jnp.stack(outs)
+
+        fn = shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(
+                {k: P("data") for k in col_keys},
+                [P() for _ in range(n_aux)],
+                P("data"),
+                P("data"),
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )
+        self._program = jax.jit(fn)
+        self._program_key = key
+        return self._program

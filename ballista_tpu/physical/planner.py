@@ -56,7 +56,57 @@ class PhysicalPlanner:
             raise PlanError(
                 f"physical schema {pnames} != logical schema {lnames}\n{plan}\n{p}"
             )
+        self._annotate_topk(p)
         return p
+
+    @staticmethod
+    def _annotate_topk(root: ExecutionPlan) -> None:
+        """Mark Limit(Sort(Projection?(Aggregate))) chains on the aggregate:
+        the TPU fact-aggregation path (ops/factagg.py) uses the annotation to
+        fuse a device top-k epilogue so only ~4k candidate groups are read
+        back instead of all of them. Host execution ignores it: the
+        aggregate still emits every group unless a device stage honors the
+        hint, and the Sort/Limit above always re-applies the full ordering."""
+        from ballista_tpu.physical import expr as px
+        from ballista_tpu.physical.aggregate import AggregateMode, HashAggregateExec
+        from ballista_tpu.physical.basic import GlobalLimitExec, ProjectionExec, SortExec
+
+        def walk(node: ExecutionPlan) -> None:
+            for c in node.children():
+                walk(c)
+            if not isinstance(node, GlobalLimitExec) or not node.limit:
+                return
+            s = node.children()[0]
+            if not isinstance(s, SortExec) or not s.sort_keys:
+                return
+            p = s.input
+            proj = None
+            if isinstance(p, ProjectionExec):
+                proj, p = p, p.input
+            if not isinstance(p, HashAggregateExec) or p.mode != AggregateMode.SINGLE:
+                return
+            first, asc, _nulls = s.sort_keys[0]
+            if not isinstance(first, px.ColumnExpr):
+                return
+            idx = first.index
+            if proj is not None:
+                e = proj.exprs[idx][0]
+                if not isinstance(e, px.ColumnExpr):
+                    return
+                idx = e.index
+            ngroup = len(p.group_exprs)
+            if idx < ngroup:
+                return  # ordered by a group key, not an aggregate value
+            p._topk_pushdown = {
+                "agg_index": idx - ngroup,
+                "descending": not asc,
+                "k": int(node.limit) + int(getattr(node, "skip", 0) or 0),
+                # secondary sort keys make tie order deterministic; the
+                # device candidate pool must detect boundary ties then
+                "strict": len(s.sort_keys) > 1,
+            }
+
+        walk(root)
 
     # ------------------------------------------------------------------
     def _plan(self, plan: lp.LogicalPlan) -> ExecutionPlan:

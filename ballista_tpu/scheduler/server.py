@@ -107,7 +107,7 @@ class SchedulerServer:
     def _plan_job(self, job_id: str, plan, config) -> None:
         ctx = ExecutionContext(config)
         physical = ctx.create_physical_plan(plan)
-        stages = DistributedPlanner().plan_query_stages(job_id, physical)
+        stages = DistributedPlanner(config).plan_query_stages(job_id, physical)
         for stage in stages:
             self.state.save_stage_plan(job_id, stage.stage_id, stage)
             n = stage.output_partitioning().partition_count()

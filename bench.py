@@ -104,6 +104,22 @@ def main() -> None:
         c = min(run_once("cpu", TRIP_AGG_QUERY) for _ in range(2))
         print(f"[side] taxi_10M_265groups: tpu={t*1000:.0f}ms cpu={c*1000:.0f}ms "
               f"speedup={c/t:.2f}x", file=sys.stderr)
+
+        # high-cardinality variant: 10k zones (block-level granularity)
+        taxi_hc = REPO / ".bench_cache" / "taxi_hc_sf1"
+        if not (taxi_hc / "trips").exists():
+            taxi_gen(str(taxi_hc), sf=1.0, parts=1, n_zones=10_000)
+        hc_query = TRIP_AGG_QUERY.replace("from trips", "from trips_hc")
+        for backend in ("tpu", "cpu"):
+            ctx = _context(backend)
+            if "trips_hc" not in ctx.tables:
+                ctx.register_parquet("trips_hc", str(taxi_hc / "trips"))
+        run_once("tpu", hc_query)
+        t = min(run_once("tpu", hc_query) for _ in range(2))
+        run_once("cpu", hc_query)
+        c = min(run_once("cpu", hc_query) for _ in range(2))
+        print(f"[side] taxi_10M_10kgroups: tpu={t*1000:.0f}ms cpu={c*1000:.0f}ms "
+              f"speedup={c/t:.2f}x", file=sys.stderr)
     except Exception as e:
         print(f"[side] taxi: failed: {e}", file=sys.stderr)
     for q in SIDE_QUERIES:

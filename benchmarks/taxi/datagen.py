@@ -20,14 +20,17 @@ import pyarrow.parquet as pq
 N_ZONES = 265
 
 
-def gen_trips(sf: float, seed: int = 20260728) -> pa.Table:
+def gen_trips(sf: float, seed: int = 20260728, n_zones: int = N_ZONES) -> pa.Table:
+    """n_zones=265 matches the TLC zone map; larger values emulate finer
+    geo granularity (e.g. block-level ids) for the high-cardinality
+    group-by configuration."""
     n = max(1, int(10_000_000 * sf))
     rng = np.random.default_rng(seed)
     # zone popularity follows a heavy tail like the real data
-    zone_weights = rng.pareto(1.2, N_ZONES) + 1
+    zone_weights = rng.pareto(1.2, n_zones) + 1
     zone_weights /= zone_weights.sum()
-    pu = rng.choice(N_ZONES, n, p=zone_weights).astype(np.int64) + 1
-    do = rng.choice(N_ZONES, n, p=zone_weights).astype(np.int64) + 1
+    pu = rng.choice(n_zones, n, p=zone_weights).astype(np.int64) + 1
+    do = rng.choice(n_zones, n, p=zone_weights).astype(np.int64) + 1
     start = np.datetime64("2024-01-01").astype("datetime64[s]").astype(np.int64)
     pickup_ts = start + rng.integers(0, 31 * 24 * 3600, n)
     duration = rng.gamma(2.0, 420.0, n).astype(np.int64) + 60
@@ -63,8 +66,9 @@ TRIP_AGG_QUERY = """
 """
 
 
-def generate(out_dir: str, sf: float = 0.1, parts: int = 1, seed: int = 20260728) -> None:
-    table = gen_trips(sf, seed)
+def generate(out_dir: str, sf: float = 0.1, parts: int = 1, seed: int = 20260728,
+             n_zones: int = N_ZONES) -> None:
+    table = gen_trips(sf, seed, n_zones)
     d = os.path.join(out_dir, "trips")
     os.makedirs(d, exist_ok=True)
     n = table.num_rows

@@ -1,0 +1,159 @@
+"""Measure high-cardinality grouped-aggregation strategies on the real chip.
+
+Candidates for G > 1024 (where the unrolled per-group path stops scaling):
+  A. two-level one-hot matmul: code = hi*K2 + lo; out[hi, lo] accumulated as
+     H^T @ (L * v) per row block on the MXU. FLOPs = 2*N*G*n_out.
+  B. XLA segment_sum (scatter lowering), unsorted vs sorted codes.
+  C. device argsort cost (per-query sort if we wanted sort-based agg).
+  D. host baselines: np.bincount and pyarrow group_by.
+
+Run: python dev/probe_highcard.py  (real TPU via default env)
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def bench(fn, *args, reps=3):
+    out = fn(*args)
+    out.block_until_ready()  # compile + warmup
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        out.block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+
+    print("backend:", jax.default_backend(), jax.devices())
+
+    N = 6_000_000
+    rng = np.random.default_rng(0)
+    v_np = rng.uniform(0.0, 100_000.0, N).astype(np.float32)
+    mask_np = rng.uniform(size=N) < 0.54
+
+    for G in (8192, 131072):
+        codes_np = rng.integers(0, G, N).astype(np.int32)
+        # f64 oracle
+        oracle = np.zeros(G)
+        np.add.at(oracle, codes_np[mask_np], v_np[mask_np].astype(np.float64))
+        ocnt = np.zeros(G)
+        np.add.at(ocnt, codes_np[mask_np], 1.0)
+
+        t0 = time.perf_counter()
+        w = np.where(mask_np, v_np, 0).astype(np.float64)
+        hb = np.bincount(codes_np, weights=w, minlength=G)
+        t_host = time.perf_counter() - t0
+        print(f"\nG={G}  host np.bincount(f64): {t_host*1e3:.1f}ms "
+              f"(relerr {np.abs(hb - oracle).max() / max(1, oracle.max()):.1e})")
+
+        codes = jnp.asarray(codes_np)
+        v = jnp.asarray(v_np)
+        mask = jnp.asarray(mask_np)
+
+        def acc(got, name, t):
+            got = np.asarray(got, dtype=np.float64)
+            rel = np.abs(got - oracle).max() / max(1.0, np.abs(oracle).max())
+            print(f"  {name:42s} {t*1e3:8.1f}ms  maxrel {rel:.1e}")
+
+        # --- A: two-level matmul ---------------------------------------
+        for K2 in (128, 256):
+            K1 = G // K2
+            for prec_name in ("default", "split2", "highest"):
+
+                @partial(jax.jit, static_argnames=("k1", "k2", "prec"))
+                def two_level(codes, v, mask, k1, k2, prec):
+                    B = 1 << 16
+                    nb = codes.shape[0] // B
+
+                    def body(carry, xs):
+                        c, vv, m = xs
+                        hi = c // k2
+                        lo = c % k2
+                        mv = vv * m.astype(jnp.float32)
+                        H = (hi[:, None] == jax.lax.broadcasted_iota(
+                            jnp.int32, (1, k1), 1)).astype(jnp.float32)
+                        L = (lo[:, None] == jax.lax.broadcasted_iota(
+                            jnp.int32, (1, k2), 1)).astype(jnp.float32)
+                        M = L * mv[:, None]
+                        Mc = L * m.astype(jnp.float32)[:, None]
+                        if prec == "split2":
+                            M1 = M.astype(jnp.bfloat16).astype(jnp.float32)
+                            M2 = M - M1
+                            s = (jnp.dot(H.T, M1, preferred_element_type=jnp.float32)
+                                 + jnp.dot(H.T, M2, preferred_element_type=jnp.float32))
+                        else:
+                            p = (jax.lax.Precision.HIGHEST if prec == "highest"
+                                 else jax.lax.Precision.DEFAULT)
+                            s = jnp.dot(H.T, M, precision=p,
+                                        preferred_element_type=jnp.float32)
+                        cs = jnp.dot(H.T, Mc, precision=jax.lax.Precision.DEFAULT,
+                                     preferred_element_type=jnp.float32)
+                        return (carry[0] + s, carry[1] + cs), None
+
+                    init = (jnp.zeros((k1, k2), jnp.float32),
+                            jnp.zeros((k1, k2), jnp.float32))
+                    (s, cs), _ = jax.lax.scan(
+                        body, init,
+                        (codes.reshape(nb, B), v.reshape(nb, B),
+                         mask.reshape(nb, B)))
+                    return jnp.stack([s.reshape(-1), cs.reshape(-1)])
+
+                try:
+                    t, out = bench(two_level, codes, v, mask, K1, K2, prec_name)
+                    acc(np.asarray(out)[0], f"two_level K2={K2} {prec_name}", t)
+                except Exception as e:
+                    print(f"  two_level K2={K2} {prec_name}: FAIL {type(e).__name__} {e}"[:200])
+
+        # --- B: segment_sum --------------------------------------------
+        @jax.jit
+        def seg_unsorted(codes, v, mask):
+            return jax.ops.segment_sum(v * mask.astype(jnp.float32), codes,
+                                       num_segments=G)
+
+        try:
+            t, out = bench(seg_unsorted, codes, v, mask)
+            acc(out, "segment_sum unsorted", t)
+        except Exception as e:
+            print("  segment_sum unsorted FAIL", repr(e)[:120])
+
+        order = np.argsort(codes_np, kind="stable")
+        codes_s = jnp.asarray(codes_np[order])
+        v_s = jnp.asarray(v_np[order])
+        mask_s = jnp.asarray(mask_np[order])
+
+        @jax.jit
+        def seg_sorted(codes, v, mask):
+            return jax.ops.segment_sum(v * mask.astype(jnp.float32), codes,
+                                       num_segments=G, indices_are_sorted=True)
+
+        try:
+            t, out = bench(seg_sorted, codes_s, v_s, mask_s)
+            acc(out, "segment_sum sorted", t)
+        except Exception as e:
+            print("  segment_sum sorted FAIL", repr(e)[:120])
+
+        # --- C: device argsort -----------------------------------------
+        @jax.jit
+        def dev_sort(codes):
+            return jnp.argsort(codes)
+
+        try:
+            t, _ = bench(dev_sort, codes)
+            print(f"  {'device argsort(int32)':42s} {t*1e3:8.1f}ms")
+        except Exception as e:
+            print("  device argsort FAIL", repr(e)[:120])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Probe v2: chunked-segment-layout aggregation (cache-time sorted residency).
+
+Adaptive L1 (covers ~90th pct of segment lengths), fully vectorized build,
+and dispatch-vs-d2h timing split.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def chunk_spans(starts: np.ndarray, lens: np.ndarray, L: int):
+    """Vectorized: split each [start, start+len) into chunks of <= L rows.
+    Returns take-index [V, L], pad mask [V, L] f32, owner [V] (group of each
+    chunk), all in group order."""
+    nchunks = np.maximum(-(-lens // L), 1)
+    V = int(nchunks.sum())
+    owner = np.repeat(np.arange(len(lens)), nchunks)
+    # position of each chunk within its group, vectorized
+    firsts = np.zeros(V, dtype=np.int64)
+    firsts[np.cumsum(nchunks)[:-1]] = nchunks[:-1]
+    chunk_pos = np.arange(V) - np.cumsum(firsts) + firsts.cumsum() * 0
+    # simpler: global arange minus repeated group-chunk-offsets
+    offs = np.repeat(np.cumsum(nchunks) - nchunks, nchunks)
+    chunk_pos = np.arange(V) - offs
+    cstart = starts[owner] + chunk_pos * L
+    clen = np.minimum(lens[owner] - chunk_pos * L, L)
+    clen = np.maximum(clen, 0)
+    idx = cstart[:, None] + np.arange(L)[None, :]
+    pad = np.arange(L)[None, :] < clen[:, None]
+    idx = np.where(pad, idx, 0)
+    return idx.astype(np.int32), pad.astype(np.float32), owner
+
+
+def build_layout(codes_sorted: np.ndarray, L2: int = 128):
+    G = int(codes_sorted[-1]) + 1
+    starts = np.searchsorted(codes_sorted, np.arange(G))
+    ends = np.searchsorted(codes_sorted, np.arange(G), side="right")
+    lens = ends - starts
+    # L1: power of two covering the 90th percentile length, in [8, 1024]
+    p90 = int(np.percentile(lens, 90)) if len(lens) else 8
+    L1 = 8
+    while L1 < p90 and L1 < 1024:
+        L1 <<= 1
+    idx1, pad1, owner = chunk_spans(starts, lens, L1)
+    levels = [(idx1, pad1)]
+    while len(owner) != G:
+        o_starts = np.searchsorted(owner, np.arange(G))
+        o_ends = np.searchsorted(owner, np.arange(G), side="right")
+        idx, pad, owner = chunk_spans(o_starts, o_ends - o_starts, L2)
+        levels.append((idx, pad))
+    return levels, G, L1
+
+
+def main():
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    print("backend:", jax.default_backend())
+    rng = np.random.default_rng(0)
+
+    cases = [("q3ish", 6_000_000, None, "lineitem"),
+             ("taxi", 10_000_000, 10_000, "zipf")]
+    for name, N, G_req, kind in cases:
+        if kind == "lineitem":
+            lens = rng.integers(1, 8, N // 4)
+            codes_all = np.repeat(np.arange(len(lens), dtype=np.int32), lens)[:N]
+        else:
+            z = rng.zipf(1.3, N).astype(np.int64)
+            codes_all = (z % G_req).astype(np.int32)
+            _, codes_all = np.unique(codes_all, return_inverse=True)
+            codes_all = codes_all.astype(np.int32)
+        if len(codes_all) < N:
+            codes_all = np.concatenate(
+                [codes_all, np.full(N - len(codes_all), codes_all[-1], np.int32)])
+        codes_all = np.sort(codes_all[:N])
+        v_np = rng.uniform(0, 100_000, N).astype(np.float32)
+        filt_np = rng.uniform(0, 1, N).astype(np.float32)
+
+        t0 = time.perf_counter()
+        levels, G, L1 = build_layout(codes_all)
+        t_build = time.perf_counter() - t0
+        idx1, pad1 = levels[0]
+        V1 = pad1.shape[0]
+        waste = V1 * L1 / N
+
+        t0 = time.perf_counter()
+        v_l = jnp.asarray(v_np[idx1.reshape(-1)].reshape(V1, L1))
+        f_l = jnp.asarray(filt_np[idx1.reshape(-1)].reshape(V1, L1))
+        pad1_d = jnp.asarray(pad1)
+        upper = [(jnp.asarray(i), jnp.asarray(p)) for i, p in levels[1:]]
+        jax.block_until_ready((v_l, f_l, pad1_d))
+        t_resid = time.perf_counter() - t0
+        print(f"\n{name}: N={N} G={G} L1={L1} layout={V1}x{L1} "
+              f"(waste {waste:.2f}x) levels={[p.shape for _, p in levels]} "
+              f"build={t_build*1e3:.0f}ms resid={t_resid*1e3:.0f}ms")
+
+        @jax.jit
+        def query(v_l, f_l, pad1_d, cutoff):
+            mask = (f_l > cutoff).astype(jnp.float32) * pad1_d
+            s = jnp.sum(v_l * mask, axis=1)
+            c = jnp.sum(mask, axis=1)
+            for idx, pad in upper:
+                s = jnp.sum(s[idx] * pad, axis=1)
+                c = jnp.sum(c[idx] * pad, axis=1)
+            return jnp.stack([s, c])
+
+        out = query(v_l, f_l, pad1_d, 0.46)
+        out.block_until_ready()
+        t_disp = t_tot = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = query(v_l, f_l, pad1_d, 0.46)
+            out.block_until_ready()
+            t1 = time.perf_counter()
+            got = np.asarray(out)
+            t2 = time.perf_counter()
+            t_disp = min(t_disp, t1 - t0)
+            t_tot = min(t_tot, t2 - t0)
+
+        m = filt_np > 0.46
+        oracle = np.zeros(G)
+        np.add.at(oracle, codes_all[m], v_np[m].astype(np.float64))
+        rel = np.abs(got[0].astype(np.float64) - oracle).max() / max(1, oracle.max())
+        t0 = time.perf_counter()
+        w = np.where(m, v_np, 0).astype(np.float64)
+        np.bincount(codes_all, weights=w, minlength=G)
+        t_host = time.perf_counter() - t0
+        print(f"  compute {t_disp*1e3:7.2f}ms  +d2h {t_tot*1e3:7.2f}ms  "
+              f"maxrel {rel:.1e}   host bincount(f64): {t_host*1e3:.0f}ms")
+
+
+if __name__ == "__main__":
+    main()

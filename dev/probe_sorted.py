@@ -1,0 +1,74 @@
+"""Correctness + perf probe for sorted_grouped_sum on the current backend.
+
+python dev/probe_sorted.py            # real TPU
+JAX_PLATFORMS=cpu python dev/probe_sorted.py   # interpret mode
+"""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")  # axon overrides the env var
+    import jax.numpy as jnp
+
+    from ballista_tpu.ops.pallas_kernels import SORT_BLOCK, sorted_grouped_sum
+
+    print("backend:", jax.default_backend())
+    rng = np.random.default_rng(0)
+
+    for N, G in ((1 << 15, 700), (6_000_000, 1_500_000), (6_000_000, 10_000)):
+        if jax.default_backend() == "cpu" and N > 1 << 15:
+            continue
+        # sorted dense ranks with random segment lengths
+        lens = rng.integers(1, max(2, 2 * N // G), G)
+        codes_np = np.repeat(np.arange(G, dtype=np.int32), lens)[:N]
+        if len(codes_np) < N:
+            codes_np = np.concatenate(
+                [codes_np, np.full(N - len(codes_np), codes_np[-1], np.int32)]
+            )
+        G_real = int(codes_np.max()) + 1
+        v_np = rng.uniform(0, 100_000, N).astype(np.float32)
+        mask_np = (rng.uniform(size=N) < 0.54).astype(np.float32)
+
+        pad = (-N) % SORT_BLOCK
+        if pad:
+            codes_np = np.concatenate([codes_np, np.full(pad, codes_np[-1], np.int32)])
+            v_np = np.concatenate([v_np, np.zeros(pad, np.float32)])
+            mask_np = np.concatenate([mask_np, np.zeros(pad, np.float32)])
+
+        vals_np = np.stack([mask_np, v_np * mask_np])
+        codes = jnp.asarray(codes_np)
+        vals = jnp.asarray(vals_np)
+
+        out = sorted_grouped_sum(codes, vals, G_real)
+        out.block_until_ready()
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = sorted_grouped_sum(codes, vals, G_real)
+            out.block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        got = np.asarray(out, dtype=np.float64)
+
+        oracle_sum = np.zeros(G_real)
+        np.add.at(oracle_sum, codes_np, (v_np * mask_np).astype(np.float64))
+        oracle_cnt = np.zeros(G_real)
+        np.add.at(oracle_cnt, codes_np, mask_np.astype(np.float64))
+        rel_s = np.abs(got[1] - oracle_sum).max() / max(1.0, oracle_sum.max())
+        rel_c = np.abs(got[0] - oracle_cnt).max()
+        print(f"N={N} G={G_real}: {best*1e3:8.2f}ms  sum maxrel {rel_s:.2e}  "
+              f"count maxabs {rel_c:.1e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,178 @@
+"""Fact-side aggregation pushdown (ops/factagg.py): Aggregate over a PK-FK
+join runs as host-dim + device fact partials + (optional) device top-k."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.ops import kernels
+
+
+@pytest.fixture
+def star(tmp_path):
+    """Fact table (20k rows, 3k distinct keys) + dim table (unique key)."""
+    rng = np.random.default_rng(5)
+    nf, nk = 20_000, 3000
+    fact = pa.table(
+        {
+            "fk": pa.array(rng.integers(0, nk, nf), type=pa.int64()),
+            "amount": pa.array(np.round(rng.uniform(1, 500, nf), 2)),
+            "disc": pa.array(np.round(rng.uniform(0, 0.1, nf), 3)),
+            "flag": pa.array(rng.integers(0, 2, nf), type=pa.int64()),
+        }
+    )
+    dim = pa.table(
+        {
+            "dk": pa.array(np.arange(nk), type=pa.int64()),
+            "attr": pa.array([f"grp-{i % 37}" for i in range(nk)]),
+            "region": pa.array([f"r{i % 5}" for i in range(nk)]),
+        }
+    )
+    pq.write_table(fact, str(tmp_path / "fact.parquet"))
+    pq.write_table(dim, str(tmp_path / "dim.parquet"))
+    return tmp_path
+
+
+def _ctx(backend, star):
+    ctx = ExecutionContext(
+        BallistaConfig({"ballista.executor.backend": backend})
+    )
+    ctx.register_parquet("fact", str(star / "fact.parquet"))
+    ctx.register_parquet("dim", str(star / "dim.parquet"))
+    return ctx
+
+
+Q_TOPK = """
+    select fk, sum(amount * (1 - disc)) as rev, attr
+    from dim, fact
+    where dk = fk and flag = 1
+    group by fk, attr
+    order by rev desc
+    limit 15
+"""
+
+Q_FULL = """
+    select fk, sum(amount) as s, count(amount) as c, avg(amount) as a, attr
+    from dim, fact
+    where dk = fk
+    group by fk, attr
+    order by fk
+"""
+
+
+def _factagg_stages():
+    from ballista_tpu.ops.factagg import FactAggregateStage
+
+    return [
+        s for s in kernels._stage_cache.values()
+        if isinstance(s, FactAggregateStage)
+    ]
+
+
+def test_topk_pushdown_matches_host(star):
+    kernels._stage_cache.clear()
+    t = _ctx("tpu", star).sql(Q_TOPK).collect()
+    h = _ctx("host", star).sql(Q_TOPK).collect()
+    assert t.column("fk").to_pylist() == h.column("fk").to_pylist()
+    assert t.column("attr").to_pylist() == h.column("attr").to_pylist()
+    np.testing.assert_allclose(
+        t.column("rev").to_numpy(), h.column("rev").to_numpy(), rtol=1e-4
+    )
+    stages = _factagg_stages()
+    assert stages and stages[0].topk is not None, "top-k epilogue not engaged"
+
+
+def test_full_select_matches_host(star):
+    kernels._stage_cache.clear()
+    t = _ctx("tpu", star).sql(Q_FULL).collect()
+    h = _ctx("host", star).sql(Q_FULL).collect()
+    assert t.num_rows == h.num_rows  # keys present in fact (~3000)
+    assert t.num_rows > 2900
+    assert t.column("fk").to_pylist() == h.column("fk").to_pylist()
+    assert t.column("attr").to_pylist() == h.column("attr").to_pylist()
+    assert t.column("c").to_pylist() == h.column("c").to_pylist()
+    np.testing.assert_allclose(
+        t.column("s").to_numpy(), h.column("s").to_numpy(), rtol=1e-4, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        t.column("a").to_numpy(), h.column("a").to_numpy(), rtol=1e-4, atol=1e-4
+    )
+    stages = _factagg_stages()
+    assert stages and stages[0].topk is None  # member-select path
+
+
+def test_duplicate_dim_keys_fall_back_to_host(star, tmp_path):
+    """A dim side with duplicate join keys multiplies fact rows; the
+    pushdown must decline and the host join must produce the answer."""
+    rng = np.random.default_rng(6)
+    dim2 = pa.table(
+        {
+            "dk": pa.array(np.concatenate([np.arange(3000), [0, 1, 2]]),
+                           type=pa.int64()),
+            "attr": pa.array([f"a{i}" for i in range(3003)]),
+        }
+    )
+    pq.write_table(dim2, str(tmp_path / "dim2.parquet"))
+    sql = """
+        select fk, sum(amount) as s, attr from dim2, fact
+        where dk = fk group by fk, attr order by fk, attr
+    """
+    outs = {}
+    for backend in ("tpu", "host"):
+        ctx = _ctx(backend, star)
+        ctx.register_parquet("dim2", str(tmp_path / "dim2.parquet"))
+        outs[backend] = ctx.sql(sql).collect()
+    assert outs["tpu"].column("fk").to_pylist() == outs["host"].column("fk").to_pylist()
+    np.testing.assert_allclose(
+        outs["tpu"].column("s").to_numpy(), outs["host"].column("s").to_numpy(),
+        rtol=1e-4, atol=1e-3,
+    )
+
+
+def test_no_match_keys_empty_result(star):
+    sql = """
+        select fk, sum(amount) as s from dim, fact
+        where dk = fk and dk > 100000 group by fk
+    """
+    t = _ctx("tpu", star).sql(sql).collect()
+    assert t.num_rows == 0
+
+
+def test_topk_over_integer_sum(star):
+    """ORDER BY SUM(int_col) LIMIT k: the device score must decode BOTH
+    packed halves — ranking by the hi half alone collapses sums below 65536
+    into ties (review regression)."""
+    kernels._stage_cache.clear()
+    sql = """
+        select fk, sum(flag) as nf from dim, fact
+        where dk = fk group by fk order by nf desc limit 10
+    """
+    t = _ctx("tpu", star).sql(sql).collect()
+    h = _ctx("host", star).sql(sql).collect()
+    assert t.column("nf").to_pylist() == h.column("nf").to_pylist()
+    stages = _factagg_stages()
+    assert stages and stages[0].topk is not None
+
+
+def test_planner_annotates_topk(star):
+    ctx = _ctx("host", star)
+    df = ctx.sql(Q_TOPK)
+    plan = ctx.create_physical_plan(df.logical_plan())
+    from ballista_tpu.physical.aggregate import HashAggregateExec
+
+    def find(node):
+        if isinstance(node, HashAggregateExec):
+            return node
+        for c in node.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    agg = find(plan)
+    assert agg is not None
+    tk = getattr(agg, "_topk_pushdown", None)
+    assert tk == {"agg_index": 0, "descending": True, "k": 15, "strict": False}

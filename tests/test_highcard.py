@@ -1,0 +1,198 @@
+"""High-cardinality device aggregation: the sorted chunked-segment layout
+(ops/layout.py) replaces the round-1 MAX_GROUPS=1024 decline-to-host."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.logical import col, functions as F, lit
+
+
+def _write(tmp_path, table, name="t.parquet"):
+    p = tmp_path / name
+    pq.write_table(table, str(p))
+    return str(p)
+
+
+def _ctx(backend):
+    return ExecutionContext(BallistaConfig({"ballista.executor.backend": backend}))
+
+
+def _make_table(n=200_000, g=5000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "k": pa.array(rng.integers(0, g, n), type=pa.int64()),
+            "v": pa.array(rng.uniform(-100, 100, n).astype(np.float64)),
+            "w": pa.array(rng.integers(-1000, 1000, n), type=pa.int64()),
+            "f": pa.array(rng.uniform(0, 1, n).astype(np.float64)),
+        }
+    )
+
+
+def test_highcard_groupby_matches_host_and_oracle(tmp_path):
+    table = _make_table()
+    path = _write(tmp_path, table)
+
+    results = {}
+    for backend in ("tpu", "host"):
+        ctx = _ctx(backend)
+        ctx.register_parquet("t", path)
+        df = (
+            ctx.table("t")
+            .filter(col("f") > lit(0.25))
+            .aggregate(
+                [col("k")],
+                [
+                    F.sum(col("v")).alias("sv"),
+                    F.count(col("v")).alias("c"),
+                    F.min(col("v")).alias("mn"),
+                    F.max(col("v")).alias("mx"),
+                    F.avg(col("v")).alias("av"),
+                    F.sum(col("w")).alias("sw"),
+                ],
+            )
+            .sort(col("k").sort())
+        )
+        results[backend] = df.collect()
+
+    t, h = results["tpu"], results["host"]
+    assert t.column("k").to_pylist() == h.column("k").to_pylist()
+    assert t.column("c").to_pylist() == h.column("c").to_pylist()
+    # integer sums are exact on the device path
+    assert t.column("sw").to_pylist() == h.column("sw").to_pylist()
+    # float sums carry the documented f32 accumulation tolerance: absolute
+    # error ~ eps * sum(|v|) per group, which dominates rtol when values
+    # cancel (sums near zero)
+    # min/max carry f32 narrowing of the f64 source column (rel ~ 6e-8)
+    for name, rtol, atol in (("sv", 1e-4, 2e-3), ("mn", 1e-6, 1e-5),
+                             ("mx", 1e-6, 1e-5), ("av", 1e-4, 1e-4)):
+        np.testing.assert_allclose(
+            t.column(name).to_numpy(), h.column(name).to_numpy(), rtol=rtol,
+            atol=atol, err_msg=name,
+        )
+
+    # independent pyarrow oracle on one aggregate
+    mask = np.asarray(table.column("f")) > 0.25
+    oracle = (
+        table.filter(pa.array(mask))
+        .group_by("k")
+        .aggregate([("v", "sum")])
+        .sort_by("k")
+    )
+    np.testing.assert_allclose(
+        t.column("sv").to_numpy(), oracle.column("v_sum").to_numpy(),
+        rtol=1e-4, atol=2e-3,
+    )
+
+
+def test_highcard_uses_sorted_layout(tmp_path):
+    """Belt-and-braces: the query above must actually run the sorted device
+    path, not silently fall back to host."""
+    from ballista_tpu.ops import kernels
+
+    table = _make_table(n=50_000, g=3000)
+    path = _write(tmp_path, table)
+    kernels._stage_cache.clear()
+    kernels._stage_cache_pins.clear()
+    ctx = _ctx("tpu")
+    ctx.register_parquet("t", path)
+    out = (
+        ctx.table("t")
+        .aggregate([col("k")], [F.sum(col("v")).alias("s")])
+        .collect()
+    )
+    assert out.num_rows == 3000
+    stages = [s for s in kernels._stage_cache.values() if s not in (False, None)]
+    assert stages, "device stage was not engaged"
+    kinds = {
+        ent.get("kind")
+        for s in stages
+        for ent in s._device_cache.values()
+    }
+    assert "sorted" in kinds
+
+
+def test_skewed_groups_multi_chunk_fold(tmp_path):
+    """One giant group among many small ones exercises the chunk fold
+    (owner reduceat) path, min/max included."""
+    rng = np.random.default_rng(1)
+    k = np.concatenate([np.zeros(120_000, np.int64),
+                        rng.integers(1, 2000, 30_000)])
+    v = rng.uniform(-50, 50, len(k))
+    table = pa.table({"k": k, "v": v})
+    path = _write(tmp_path, table)
+
+    outs = {}
+    for backend in ("tpu", "host"):
+        ctx = _ctx(backend)
+        ctx.register_parquet("t", path)
+        outs[backend] = (
+            ctx.table("t")
+            .aggregate([col("k")], [F.sum(col("v")).alias("s"),
+                                    F.min(col("v")).alias("mn"),
+                                    F.max(col("v")).alias("mx"),
+                                    F.count(col("v")).alias("c")])
+            .sort(col("k").sort())
+            .collect()
+        )
+    t, h = outs["tpu"], outs["host"]
+    assert t.column("c").to_pylist() == h.column("c").to_pylist()
+    np.testing.assert_allclose(t.column("s").to_numpy(), h.column("s").to_numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(t.column("mn").to_numpy(), h.column("mn").to_numpy())
+    np.testing.assert_allclose(t.column("mx").to_numpy(), h.column("mx").to_numpy())
+
+
+def test_int_sum_exactness_small_g(tmp_path):
+    """Integer sums on the unrolled (small-G) path are exact even where f32
+    would round (values above 2^24)."""
+    rng = np.random.default_rng(2)
+    n = 50_000
+    table = pa.table(
+        {
+            "k": pa.array(rng.integers(0, 4, n), type=pa.int64()),
+            "v": pa.array(rng.integers(16_000_000, 17_000_000, n), type=pa.int64()),
+        }
+    )
+    path = _write(tmp_path, table)
+    outs = {}
+    for backend in ("tpu", "host"):
+        ctx = _ctx(backend)
+        ctx.register_parquet("t", path)
+        outs[backend] = (
+            ctx.table("t")
+            .aggregate([col("k")], [F.sum(col("v")).alias("s")])
+            .sort(col("k").sort())
+            .collect()
+        )
+    # int32 would overflow on these sums -> device declines, host path runs,
+    # results still exact
+    assert outs["tpu"].column("s").to_pylist() == outs["host"].column("s").to_pylist()
+
+
+def test_int_sum_exact_on_device(tmp_path):
+    """In-range integer sums accumulate in int32 on device and come back
+    exact (the ADVICE r1 f32-rounding case)."""
+    rng = np.random.default_rng(3)
+    n = 60_000
+    k = rng.integers(0, 8, n)
+    v = rng.integers(250, 300, n)  # per-group sums ~2.1e6 > 2^24 / 8
+    table = pa.table({"k": pa.array(k, type=pa.int64()),
+                      "v": pa.array(v, type=pa.int64())})
+    path = _write(tmp_path, table)
+    ctx = _ctx("tpu")
+    ctx.register_parquet("t", path)
+    out = (
+        ctx.table("t")
+        .aggregate([col("k")], [F.sum(col("v")).alias("s")])
+        .sort(col("k").sort())
+        .collect()
+    )
+    oracle = {}
+    for kk, vv in zip(k, v):
+        oracle[kk] = oracle.get(kk, 0) + int(vv)
+    assert out.column("s").to_pylist() == [oracle[i] for i in sorted(oracle)]

@@ -99,9 +99,12 @@ def test_dryrun_multichip_self_forces_platform():
 
     root = pathlib.Path(__file__).resolve().parent.parent
     env = dict(os.environ)
-    # child sees a 1-device CPU platform, like the driver's bare process
+    # child sees a 1-device CPU platform, like the driver's bare process;
+    # drop the axon vars so the child can't touch the TPU relay (hermetic)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = ""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
     code = (
         f"import sys; sys.path.insert(0, {str(root)!r})\n"
         "import jax\n"

@@ -1,0 +1,159 @@
+"""SPMD stage execution through the REAL distributed planner: a
+Partial -> hash exchange -> Final aggregation collapses into one
+SpmdAggregateExec stage whose exchange is a psum over the 8-device mesh
+(config ballista.tpu.spmd_stages)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.distributed.planner import DistributedPlanner
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.executor.runtime import StandaloneCluster
+from ballista_tpu.logical import col, functions as F
+from ballista_tpu.parallel.spmd_stage import SpmdAggregateExec
+
+SPMD_SETTINGS = {
+    "ballista.executor.backend": "tpu",
+    "ballista.tpu.spmd_stages": "true",
+    "ballista.tpu.mesh": "data:8",
+}
+
+
+def _sales(n=4000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "region": pa.array(
+                np.array(["east", "west", "north", "south"])[
+                    rng.integers(0, 4, n)
+                ]
+            ),
+            "amount": pa.array(rng.uniform(0, 100, n)),
+            "qty": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+        }
+    )
+
+
+def _physical(table, settings):
+    ctx = ExecutionContext(BallistaConfig(settings))
+    ctx.register_record_batches("sales", table, n_partitions=4)
+    df = ctx.table("sales").aggregate(
+        [col("region")],
+        [F.sum(col("amount")).alias("s"), F.count(col("qty")).alias("c"),
+         F.min(col("amount")).alias("mn"), F.sum(col("qty")).alias("sq")],
+    )
+    return ctx, ctx.create_physical_plan(df.logical_plan())
+
+
+def test_planner_fuses_partial_final_into_one_stage():
+    table = _sales()
+    _, phys = _physical(table, SPMD_SETTINGS)
+    cfg = BallistaConfig(SPMD_SETTINGS)
+
+    fused = DistributedPlanner(cfg).plan_query_stages("job", phys)
+    plain = DistributedPlanner().plan_query_stages("job", phys)
+
+    def nodes(plan):
+        yield plan
+        for c in plan.children():
+            yield from nodes(c)
+
+    fused_types = [type(n).__name__ for s in fused for n in nodes(s)]
+    assert "SpmdAggregateExec" in fused_types
+    # the exchange stage disappeared: one stage instead of two
+    assert len(fused) == len(plain) - 1
+
+
+def test_spmd_exec_serde_roundtrip():
+    from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
+
+    table = _sales()
+    cfg = BallistaConfig(SPMD_SETTINGS)
+    _, phys = _physical(table, SPMD_SETTINGS)
+    stages = DistributedPlanner(cfg).plan_query_stages("job", phys)
+    spmd = None
+    for s in stages:
+        def find(n):
+            if isinstance(n, SpmdAggregateExec):
+                return n
+            for c in n.children():
+                r = find(c)
+                if r is not None:
+                    return r
+            return None
+        spmd = spmd or find(s)
+    assert spmd is not None
+    back = phys_plan_from_proto(phys_plan_to_proto(spmd))
+    assert isinstance(back, SpmdAggregateExec)
+    assert back.schema() == spmd.schema()
+
+
+def test_mesh_program_matches_host():
+    """The mesh program's result equals the plain host aggregation."""
+    from ballista_tpu.physical.plan import TaskContext
+
+    table = _sales()
+    cfg = BallistaConfig(SPMD_SETTINGS)
+    ctx, phys = _physical(table, SPMD_SETTINGS)
+    stages = DistributedPlanner(cfg).plan_query_stages("job", phys)
+
+    def find(n):
+        if isinstance(n, SpmdAggregateExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    spmd = next(s for s in (find(st) for st in stages) if s is not None)
+    tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+    out = pa.Table.from_batches(list(spmd.execute(0, tctx))).sort_by("region")
+    # the host fallback would produce identical rows; require the mesh path
+    assert spmd.last_path == "mesh"
+
+    host = (
+        table.group_by("region")
+        .aggregate([("amount", "sum"), ("qty", "count"), ("amount", "min"),
+                    ("qty", "sum")])
+        .sort_by("region")
+    )
+    assert out.column("region").to_pylist() == host.column("region").to_pylist()
+    assert out.column("c").to_pylist() == host.column("qty_count").to_pylist()
+    assert out.column("sq").to_pylist() == host.column("qty_sum").to_pylist()
+    np.testing.assert_allclose(
+        out.column("s").to_numpy(), host.column("amount_sum").to_numpy(),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        out.column("mn").to_numpy(), host.column("amount_min").to_numpy(),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_distributed_spmd_end_to_end(sales_table):
+    """Full path: BallistaContext -> scheduler -> DistributedPlanner(spmd) ->
+    executor runs the mesh program -> client fetches the result."""
+    cluster = StandaloneCluster(
+        n_executors=1, config=BallistaConfig(SPMD_SETTINGS)
+    )
+    try:
+        host, port = cluster.scheduler_addr
+        c = BallistaContext(host, port, settings=SPMD_SETTINGS)
+        c.register_record_batches("sales", sales_table, n_partitions=3)
+        out = (
+            c.table("sales")
+            .aggregate([col("region")], [F.sum(col("amount")).alias("total"),
+                                         F.count(col("id")).alias("n")])
+            .sort(col("region").sort())
+            .collect()
+        )
+        assert out.column("region").to_pylist() == ["east", "north", "west"]
+        assert out.column("total").to_pylist() == [120.0, 40.0, 145.0]
+        assert out.column("n").to_pylist() == [4, 2, 4]
+        c.close()
+    finally:
+        cluster.shutdown()
