@@ -25,11 +25,6 @@ def tpu_filter(batch: pa.RecordBatch, predicate) -> Optional[pa.RecordBatch]:
     return k.filter_batch(batch, predicate) if k else None
 
 
-def tpu_project(batch: pa.RecordBatch, exprs, schema: pa.Schema) -> Optional[pa.RecordBatch]:
-    k = _kernels()
-    return k.project_batch(batch, exprs, schema) if k else None
-
-
 def tpu_hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     k = _kernels()
     return k.hash_aggregate(exec_node, partition, ctx) if k else None

@@ -234,10 +234,12 @@ class FactAggregateStage:
         if self.topk is not None and (
             self.partitions != 1
             or self.aggs[self.topk["agg_index"]].fn != "sum"
+            or self.topk["k"] > (1 << 16)
         ):
-            # per-partition partial sums cannot drive a global top-k, and
-            # the score must be a plain SUM state; fall back to the
-            # member-select readback (still correct, larger d2h)
+            # per-partition partial sums cannot drive a global top-k, the
+            # score must be a plain SUM state, and the candidate pool is
+            # capped at 64k groups; fall back to the member-select readback
+            # (still correct, larger d2h)
             self.topk = None
         self._dim_cache: Optional[dict] = None
         self._prepared: Dict[int, dict] = {}
@@ -311,13 +313,17 @@ class FactAggregateStage:
                 kk = min(k, G)
                 _, idx = two_stage_topk(masked, kk)
                 sel = jnp.take(stacked, idx, axis=1)
-                # single readback: [R_packed + 3, kk] (d2h latency is ~65ms
-                # per transfer on the relay — never return multiple arrays)
+                # single readback: [R_packed + 4, kk] (d2h latency is ~65ms
+                # per transfer on the relay — never return multiple arrays).
+                # idx travels as two exact f32 halves: a plain f32 cast loses
+                # exactness above 2^24 groups.
+                idx32 = idx.astype(jnp.int32)
                 return jnp.concatenate(
                     [
                         sel,
                         jnp.take(masked, idx)[None, :],
-                        idx.astype(jnp.float32)[None, :],
+                        (idx32 >> 16).astype(jnp.float32)[None, :],
+                        (idx32 & 0xFFFF).astype(jnp.float32)[None, :],
                         jnp.take(valid, idx).astype(jnp.float32)[None, :],
                     ]
                 )
@@ -333,7 +339,7 @@ class FactAggregateStage:
 
     # ------------------------------------------------------------------
     def _dim_side(self, ctx) -> dict:
-        """Execute + cache the dim side; build key->row index."""
+        """Execute (+ cache, if enabled) the dim side; build key->row index."""
         if self._dim_cache is not None:
             return self._dim_cache
         from ballista_tpu.physical.plan import collect_all
@@ -350,18 +356,17 @@ class FactAggregateStage:
         if len(np.unique(kn)) != len(kn):
             raise UnsupportedOnDevice("dim join key not unique")
         order = np.argsort(kn, kind="stable")
-        self._dim_cache = {
-            "table": table,
-            "keys_sorted": kn[order],
-            "order": order,
-        }
-        return self._dim_cache
+        out = {"table": table, "keys_sorted": kn[order], "order": order}
+        if ctx.config.device_cache():
+            self._dim_cache = out
+        return out
 
     def _prepare(self, partition: int, ctx) -> dict:
         ent = self._prepared.get(partition)
         if ent is not None:
             return ent
         ent = self.inner._prepare_partition_sorted(partition, ctx)
+        use_cache = ctx.config.device_cache()
         if ent["kind"] == "sorted":
             layout = ent["layout"]
             if not layout.one_chunk_per_group:
@@ -373,7 +378,10 @@ class FactAggregateStage:
             ent["rank_order"] = np.argsort(kv_np, kind="stable")
         if self._fact_step is None:
             self._fact_step = self._build_fact_step()
-        self._prepared[partition] = ent
+        if use_cache:
+            # ballista.tpu.device_cache=false: recompute per query instead
+            # of pinning the [V, L1] tiles in HBM
+            self._prepared[partition] = ent
         return ent
 
     # ------------------------------------------------------------------
@@ -403,11 +411,10 @@ class FactAggregateStage:
             packed = np.asarray(
                 self._fact_step(ent["cols"], aux, ent["pad"], jnp.asarray(bits))
             )
-            sel, scores, idx, valid = (
-                packed[:-3],
-                packed[-3],
-                packed[-2].astype(np.int64),
-                packed[-1] > 0,
+            sel, scores, valid = packed[:-4], packed[-4], packed[-1] > 0
+            idx = (
+                packed[-3].astype(np.int64) * 65536
+                + packed[-2].astype(np.int64)
             )
             sel, idx, scores = sel[:, valid], idx[valid], scores[valid]
             # With secondary sort keys the result is deterministic: if the

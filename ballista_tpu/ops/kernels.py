@@ -1,9 +1,10 @@
 """Device kernel entry points used by operator dispatch.
 
 hash_aggregate is the headline: whole-pipeline fusion via FusedAggregateStage.
-filter_batch / project_batch are per-batch lowerings used when an operator
-runs outside a fusable aggregate pipeline; they return None (host fallback)
-for shapes the device path doesn't support.
+filter_batch is a per-batch lowering used when a filter runs outside a
+fusable aggregate pipeline; it returns None (host fallback) for shapes the
+device path doesn't support. Projections have no stand-alone device path —
+they only pay off fused into a stage (FusedAggregateStage / FactAggregateStage).
 """
 
 from __future__ import annotations
@@ -167,7 +168,3 @@ def filter_batch(batch: pa.RecordBatch, predicate) -> Optional[pa.RecordBatch]:
     return batch.filter(pa.array(mask))
 
 
-def project_batch(batch: pa.RecordBatch, exprs, schema: pa.Schema) -> Optional[pa.RecordBatch]:
-    # per-batch device projection pays transfer both ways without fusion
-    # around it; the fused-stage path covers the cases that matter. Host path.
-    return None

@@ -280,9 +280,6 @@ class FusedAggregateStage:
         import jax.numpy as jnp
 
         filter_fns = self.filter_fns
-        value_fns = self.value_fns
-        aggs = self.aggs
-        int_exact = self.int_exact
 
         # XLA lowers segment_* to scatter, which serializes on TPU (measured
         # 460ms vs ~5ms for 6M rows). Group counts are capped at MAX_GROUPS
@@ -319,40 +316,19 @@ class FusedAggregateStage:
             mask = row_valid
             for f in filter_fns:
                 mask = jnp.logical_and(mask, f.fn(cols, aux))
-            maskf = mask.astype(jnp.float32)
             safe_codes = jnp.where(mask, codes, num_segments - 1)
-            counts = seg_count(safe_codes, num_segments)
-            rows = [counts]
-            for a, vf, ix in zip(aggs, value_fns, int_exact):
-                if a.fn == "count":
-                    rows.append(counts)
-                    continue
-                v = vf.fn(cols, aux)
-                v = jnp.broadcast_to(v, mask.shape)
-                if a.fn in ("sum", "avg"):
-                    if ix:
-                        vi = jnp.where(mask, v.astype(jnp.int32), 0)
-                        rows.append(seg_sum(vi, safe_codes, num_segments, 0))
-                    else:
-                        rows.append(
-                            seg_sum(v.astype(jnp.float32) * maskf, safe_codes,
-                                    num_segments, 0.0)
-                        )
-                    if a.fn == "avg":
-                        rows.append(counts)
-                elif a.fn in ("min", "max"):
-                    largest = a.fn == "max"
-                    if ix:
-                        fill = -_INT32_MAX - 1 if largest else _INT32_MAX
-                        v2 = jnp.where(mask, v.astype(jnp.int32), fill)
-                    else:
-                        fill = -jnp.inf if largest else jnp.inf
-                        v2 = jnp.where(mask, v.astype(jnp.float32), fill)
-                    rows.append(
-                        seg_extreme(v2, safe_codes, num_segments, fill,
-                                    jnp.max if largest else jnp.min)
-                    )
-            return self._stack_rows(rows)
+            return self._emit_rows(
+                cols,
+                aux,
+                mask,
+                counts=seg_count(safe_codes, num_segments),
+                reduce_sum=lambda v, zero: seg_sum(
+                    v, safe_codes, num_segments, zero
+                ),
+                reduce_extreme=lambda v, fill, red: seg_extreme(
+                    v, safe_codes, num_segments, fill, red
+                ),
+            )
 
         return step
 
@@ -370,44 +346,58 @@ class FusedAggregateStage:
         import jax.numpy as jnp
 
         filter_fns = self.filter_fns
-        value_fns = self.value_fns
-        aggs = self.aggs
-        int_exact = self.int_exact
 
         def sstep(cols, aux, pad):
             mask = pad
             for f in filter_fns:
                 mask = jnp.logical_and(mask, f.fn(cols, aux))
-            maskf = mask.astype(jnp.float32)
-            counts = jnp.sum(mask, axis=1, dtype=jnp.int32)
-            rows = [counts]
-            for a, vf, ix in zip(aggs, value_fns, int_exact):
-                if a.fn == "count":
-                    rows.append(counts)
-                    continue
-                v = vf.fn(cols, aux)
-                v = jnp.broadcast_to(v, mask.shape)
-                if a.fn in ("sum", "avg"):
-                    if ix:
-                        rows.append(
-                            jnp.sum(jnp.where(mask, v.astype(jnp.int32), 0), axis=1)
-                        )
-                    else:
-                        rows.append(jnp.sum(v.astype(jnp.float32) * maskf, axis=1))
-                    if a.fn == "avg":
-                        rows.append(counts)
-                elif a.fn in ("min", "max"):
-                    largest = a.fn == "max"
-                    if ix:
-                        fill = -_INT32_MAX - 1 if largest else _INT32_MAX
-                        v2 = jnp.where(mask, v.astype(jnp.int32), fill)
-                    else:
-                        fill = -jnp.inf if largest else jnp.inf
-                        v2 = jnp.where(mask, v.astype(jnp.float32), fill)
-                    rows.append((jnp.max if largest else jnp.min)(v2, axis=1))
-            return self._stack_rows(rows)
+            return self._emit_rows(
+                cols,
+                aux,
+                mask,
+                counts=jnp.sum(mask, axis=1, dtype=jnp.int32),
+                reduce_sum=lambda v, zero: jnp.sum(v, axis=1),
+                reduce_extreme=lambda v, fill, red: red(v, axis=1),
+            )
 
         return sstep
+
+    def _emit_rows(self, cols, aux, mask, counts, reduce_sum, reduce_extreme):
+        """Shared per-aggregate emission for both device cores. The row
+        order/dtype contract here must stay in sync with _plan_outputs /
+        _stack_rows / decode_packed_rows (and FactAggregateStage._score_row
+        builds on it). Integer aggregates stay int32 (exact, range-checked
+        at prepare time); masked-out slots use 0 for sums and +/-extreme
+        fills for min/max."""
+        import jax.numpy as jnp
+
+        maskf = mask.astype(jnp.float32)
+        rows = [counts]
+        for a, vf, ix in zip(self.aggs, self.value_fns, self.int_exact):
+            if a.fn == "count":
+                rows.append(counts)
+                continue
+            v = vf.fn(cols, aux)
+            v = jnp.broadcast_to(v, mask.shape)
+            if a.fn in ("sum", "avg"):
+                if ix:
+                    rows.append(reduce_sum(jnp.where(mask, v.astype(jnp.int32), 0), 0))
+                else:
+                    rows.append(reduce_sum(v.astype(jnp.float32) * maskf, 0.0))
+                if a.fn == "avg":
+                    rows.append(counts)
+            elif a.fn in ("min", "max"):
+                largest = a.fn == "max"
+                if ix:
+                    fill = -_INT32_MAX - 1 if largest else _INT32_MAX
+                    v2 = jnp.where(mask, v.astype(jnp.int32), fill)
+                else:
+                    fill = -jnp.inf if largest else jnp.inf
+                    v2 = jnp.where(mask, v.astype(jnp.float32), fill)
+                rows.append(
+                    reduce_extreme(v2, fill, jnp.max if largest else jnp.min)
+                )
+        return self._stack_rows(rows)
 
     # ------------------------------------------------------------------
     def _group_codes(self, batch: pa.RecordBatch) -> Tuple[np.ndarray, List[pa.Array], int]:

@@ -10,7 +10,7 @@ materialize-then-fetch, one XLA program for the whole
 Partial->shuffle->Final pipeline.
 
 SpmdAggregateExec is emitted by the DistributedPlanner (config
-`ballista.tpu.spmd` = true) in place of the
+`ballista.tpu.spmd_stages` = true) in place of the
 HashAggregate(Final) <- Repartition(hash) <- HashAggregate(Partial)
 subtree, collapsing what would be two stages + a shuffle into one stage.
 The per-shard program is driven by FusedAggregateStage's compiled

@@ -47,15 +47,10 @@ class ProjectionExec(ExecutionPlan):
         return ProjectionExec(children[0], self.exprs)
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
-        use_tpu = ctx.backend == "tpu" and ctx.config.tpu_per_op()
-        if use_tpu:
-            from ballista_tpu.ops.dispatch import tpu_project
+        # always the host Arrow path: a stand-alone device projection pays
+        # h2d + d2h per batch with nothing fused around it; projections that
+        # matter fuse into FusedAggregateStage / FactAggregateStage instead
         for batch in self.input.execute(partition, ctx):
-            if use_tpu:
-                out = tpu_project(batch, self.exprs, self._schema)
-                if out is not None:
-                    yield out
-                    continue
             arrays = []
             for (e, _name), field in zip(self.exprs, self._schema):
                 arr = _as_array(e.evaluate(batch), batch.num_rows)

@@ -197,7 +197,15 @@ class EtcdBackend(KvBackend):
         ]
 
     def put(self, key: str, value: bytes, lease_seconds: Optional[float] = None) -> None:
-        lease = self._client.lease(int(lease_seconds)) if lease_seconds else None
+        # etcd leases are whole seconds with a 1s minimum; round up so a
+        # sub-second lease never truncates to "no expiry"
+        import math
+
+        lease = (
+            self._client.lease(max(1, math.ceil(lease_seconds)))
+            if lease_seconds
+            else None
+        )
         self._client.put(key, value, lease=lease)
 
     def delete_prefix(self, prefix: str) -> None:

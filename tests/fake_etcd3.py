@@ -1,0 +1,114 @@
+"""In-process fake of the `etcd3` client API surface EtcdBackend uses.
+
+The image ships no etcd server or client library, so the distributed
+backend is exercised against this fake, which reproduces the semantics the
+reference relies on (rust/scheduler/src/state/etcd.rs:41-113): KV get /
+prefix scan (sorted, key bytes in metadata), put with TTL leases (whole
+seconds, keys invisible after expiry), delete_prefix, and a named mutex
+lock shared by every client of the same endpoint.
+
+Tests install it with `sys.modules["etcd3"] = fake_etcd3` before
+constructing EtcdBackend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+class _Server:
+    """State shared by every client dialing the same endpoint."""
+
+    def __init__(self) -> None:
+        self.data: Dict[str, Tuple[bytes, Optional[float]]] = {}
+        self.mu = threading.RLock()
+        self.locks: Dict[str, threading.Lock] = {}
+
+
+_servers: Dict[str, _Server] = {}
+_registry_mu = threading.Lock()
+
+
+def reset() -> None:
+    with _registry_mu:
+        _servers.clear()
+
+
+class _Lease:
+    def __init__(self, ttl: int) -> None:
+        if ttl < 1:
+            raise ValueError("etcd lease TTL must be >= 1 second")
+        self.ttl = ttl
+
+
+class _Meta:
+    def __init__(self, key: str) -> None:
+        self.key = key.encode()
+
+
+class _Client:
+    def __init__(self, host: str, port: int) -> None:
+        endpoint = f"{host}:{port}"
+        with _registry_mu:
+            self._server = _servers.setdefault(endpoint, _Server())
+
+    # -- kv ------------------------------------------------------------
+    def _live(self, key: str) -> Optional[bytes]:
+        item = self._server.data.get(key)
+        if item is None:
+            return None
+        value, expires = item
+        if expires is not None and time.time() > expires:
+            del self._server.data[key]
+            return None
+        return value
+
+    def get(self, key: str):
+        with self._server.mu:
+            v = self._live(key)
+            return (v, _Meta(key) if v is not None else None)
+
+    def get_prefix(self, prefix: str, sort_order: str = "ascend"):
+        with self._server.mu:
+            keys = sorted(k for k in self._server.data if k.startswith(prefix))
+            if sort_order == "descend":
+                keys.reverse()
+            out = []
+            for k in keys:
+                v = self._live(k)
+                if v is not None:
+                    out.append((v, _Meta(k)))
+            return out
+
+    def put(self, key: str, value, lease: Optional[_Lease] = None) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        with self._server.mu:
+            expires = time.time() + lease.ttl if lease is not None else None
+            self._server.data[key] = (bytes(value), expires)
+
+    def delete_prefix(self, prefix: str) -> None:
+        with self._server.mu:
+            for k in [k for k in self._server.data if k.startswith(prefix)]:
+                del self._server.data[k]
+
+    # -- lease / lock ---------------------------------------------------
+    def lease(self, ttl: int) -> _Lease:
+        return _Lease(int(ttl))
+
+    @contextlib.contextmanager
+    def lock(self, name: str):
+        with self._server.mu:
+            lk = self._server.locks.setdefault(name, threading.Lock())
+        lk.acquire()
+        try:
+            yield
+        finally:
+            lk.release()
+
+
+def client(host: str = "localhost", port: int = 2379) -> _Client:
+    return _Client(host, port)

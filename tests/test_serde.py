@@ -93,6 +93,61 @@ def test_expr_roundtrip(e):
     roundtrip_expr(e)
 
 
+# scalar edge values, mirroring the reference's ScalarValue matrix
+# (rust/core/src/serde/logical_plan/mod.rs:58-920 covers every variant with
+# boundary values)
+SCALAR_EDGE_CASES = [
+    lit(0),
+    lit(-1),
+    lit(2**63 - 1),
+    lit(-(2**63)),
+    lit(2**31),          # beyond int32
+    lit(0.0),
+    lit(-0.0),
+    lit(float("inf")),
+    lit(float("-inf")),
+    lit(float("nan")),
+    lit(5e-324),         # smallest subnormal double
+    lit(1.7976931348623157e308),
+    lit(""),
+    lit("unicode ✓ ☃ 日本語"),
+    lit("embedded 'quotes' and \"doubles\""),
+    lit("newline\nand\ttab"),
+    lx.Literal(datetime.date(1970, 1, 1), pa.date32()),
+    lx.Literal(datetime.date(1904, 2, 29), pa.date32()),   # pre-epoch leap day
+    lx.Literal(datetime.date(2262, 4, 11), pa.date32()),
+    lx.Literal(datetime.datetime(1969, 12, 31, 23, 59, 59, 999999),
+               pa.timestamp("us")),  # negative epoch micros
+    lx.Literal(False, pa.bool_()),
+]
+
+
+@pytest.mark.parametrize("e", SCALAR_EDGE_CASES, ids=lambda e: repr(str(e))[:48])
+def test_scalar_edge_roundtrip(e):
+    roundtrip_expr(e)
+
+
+def test_scalar_edge_values_survive_exactly():
+    """Beyond display equality: the decoded literal VALUE must be bit-equal
+    (display strings can hide float rounding)."""
+    import math
+
+    for e in SCALAR_EDGE_CASES:
+        msg = expr_to_proto(e)
+        from ballista_tpu.proto import ballista_pb2 as pb
+
+        decoded = pb.LogicalExprNode()
+        decoded.ParseFromString(msg.SerializeToString())
+        e2 = expr_from_proto(decoded)
+        v1, v2 = e.value, e2.value
+        if isinstance(v1, float) and math.isnan(v1):
+            assert math.isnan(v2)
+        else:
+            assert v1 == v2 and type(v1) is type(v2), (v1, v2)
+            if isinstance(v1, float):
+                assert math.copysign(1, v1) == math.copysign(1, v2)
+
+
 def _scan() -> LogicalPlanBuilder:
     table = pa.table(
         {
@@ -263,3 +318,85 @@ class TestPhysicalRoundtrip:
         self.roundtrip(r)
         u = UnresolvedShuffleExec(7, SCHEMA, 2)
         self.roundtrip(u)
+
+    def test_cross_join_union_coalesce_empty(self):
+        """Remaining node variants (ref from_proto.rs:58-345 covers all 15)."""
+        from ballista_tpu.physical.basic import (
+            CoalesceBatchesExec,
+            EmptyExec,
+            LocalLimitExec,
+            MergeExec,
+        )
+        from ballista_tpu.physical.join import CrossJoinExec
+        from ballista_tpu.physical.union import UnionExec
+
+        a = self._physical(_scan())
+        b = self._physical(_scan())
+        self.roundtrip(CrossJoinExec(a, b))
+        self.roundtrip(UnionExec([a, b]))
+        self.roundtrip(CoalesceBatchesExec(a, 4096))
+        self.roundtrip(MergeExec(a))
+        self.roundtrip(LocalLimitExec(a, 7))
+        self.roundtrip(EmptyExec(False, SCHEMA))
+        self.roundtrip(EmptyExec(True, SCHEMA))
+
+    def test_repartition_variants(self):
+        from ballista_tpu.physical.expr import ColumnExpr
+        from ballista_tpu.physical.plan import Partitioning
+        from ballista_tpu.physical.repartition import RepartitionExec
+
+        a = self._physical(_scan())
+        self.roundtrip(
+            RepartitionExec(a, Partitioning.hash([ColumnExpr("a", 0)], 8))
+        )
+        self.roundtrip(RepartitionExec(a, Partitioning.round_robin(3)))
+
+    def test_window_exec(self):
+        from ballista_tpu.physical.expr import ColumnExpr
+        from ballista_tpu.physical.window import WindowExec, WindowFuncDesc
+
+        a = self._physical(_scan())
+        w = WindowExec(
+            a,
+            [
+                WindowFuncDesc(
+                    "row_number", None, [ColumnExpr("c", 2)],
+                    [(ColumnExpr("a", 0), True)], "rn", pa.int64(),
+                ),
+                WindowFuncDesc(
+                    "sum", ColumnExpr("b", 1), [], [(ColumnExpr("a", 0), False)],
+                    "running", pa.float64(),
+                ),
+            ],
+        )
+        self.roundtrip(w)
+
+    def test_spmd_aggregate_node(self):
+        from ballista_tpu.config import BallistaConfig
+        from ballista_tpu.distributed.planner import DistributedPlanner
+        from ballista_tpu.engine import ExecutionContext
+        from ballista_tpu.parallel.spmd_stage import SpmdAggregateExec
+
+        ctx = ExecutionContext()
+        ctx.register_record_batches(
+            "t",
+            pa.table({"k": pa.array([1, 2, 1]), "v": pa.array([1.0, 2.0, 3.0])}),
+            n_partitions=2,
+        )
+        df = ctx.table("t").aggregate([col("k")], [F.sum(col("v")).alias("s")])
+        phys = ctx.create_physical_plan(df.logical_plan())
+        cfg = BallistaConfig({"ballista.tpu.spmd_stages": "true"})
+        stages = DistributedPlanner(cfg).plan_query_stages("j", phys)
+
+        def find(n):
+            if isinstance(n, SpmdAggregateExec):
+                return n
+            for c in n.children():
+                r = find(c)
+                if r is not None:
+                    return r
+            return None
+
+        spmd = next((find(s) for s in stages if find(s) is not None), None)
+        assert spmd is not None
+        self.roundtrip(spmd)
