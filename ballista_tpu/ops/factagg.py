@@ -23,11 +23,18 @@ TPU-first redesign (eager-aggregation + semi-join membership):
             aggregate's partial-state rows; the ordinary Final merge, Sort
             and Limit operators above run unchanged on K rows.
 
-Pattern matched (q3 shape): HashAggregateExec[single|partial] over
- [Filter/Projection/Coalesce]* -> HashJoinExec(inner, single equi-key) with
-one side a cacheable file-scan chain (the fact) — fact-side group key must
-be the join key; dim-side group keys are attached post-aggregation; all
-aggregate inputs must be fact-side expressions.
+Pattern matched: HashAggregateExec[single|partial] over
+ [Filter/Projection/Coalesce]* -> an INNER hash-join tree in which the
+largest file-backed scan chain (the fact) sits anywhere reachable through
+inner joins — directly (q3: orders x lineitem) or nested (q10:
+((customer x orders) x lineitem) x nation). The fact's own join must be a
+single equi-key with no residual filter; joins between it and the root must
+not be keyed on fact columns (q5 joins supplier on l_suppkey — host path).
+Fact-side group keys must be the join key; dim-side group keys are attached
+post-aggregation; all aggregate inputs must be fact-side expressions. The
+device top-k epilogue additionally requires the fact key among the group
+keys (one output group per key); dim-only grouping (q10) uses the
+member-select readback and the ordinary final merge re-groups.
 """
 
 from __future__ import annotations
@@ -58,6 +65,10 @@ from ballista_tpu.physical.basic import (
 # dim sides larger than this are not "dimension tables"; let the host join
 # handle them
 MAX_DIM_ROWS = 4_000_000
+
+# group_layout marker for "this output column is the fact join key" — a
+# sentinel object so it can never collide with a real dim column name
+FACT_KEY = object()
 # candidate multiplier for the top-k epilogue: secondary sort keys and f32
 # score ties are resolved host-side within this pool
 TOPK_POOL = 64
@@ -119,34 +130,67 @@ class FactAggregateStage:
             node = node.input
         if not isinstance(node, HashJoinExec) or node.join_type != JoinType.INNER:
             raise UnsupportedOnDevice("row source is not an inner hash join")
-        if node.filter is not None or len(node.on) != 1:
-            raise UnsupportedOnDevice("join shape (residual filter / multi-key)")
-        join = node
+        root = node
 
-        # -- pick the fact side: the larger cacheable scan chain -------
-        lleaf = _scan_chain_leaf(join.left)
-        rleaf = _scan_chain_leaf(join.right)
-        sides = []
-        if lleaf is not None:
-            sides.append(("left", lleaf, _chain_bytes(lleaf)))
-        if rleaf is not None:
-            sides.append(("right", rleaf, _chain_bytes(rleaf)))
-        sides = [s for s in sides if s[2] > 0]  # fact must be file-backed
-        if not sides:
+        # -- locate the fact scan chain anywhere in the inner-join tree --
+        # Paths may only cross INNER HashJoinExec nodes (their output schema
+        # is the concatenation of their children, so removing the fact block
+        # keeps every other column's relative order); the fact is the
+        # largest file-backed scan chain reachable that way (q10 nests
+        # lineitem two joins deep).
+        candidates: List[Tuple[list, HashJoinExec, str, int]] = []
+
+        def dfs(j, path):
+            for side in ("left", "right"):
+                child = getattr(j, side)
+                leaf = _scan_chain_leaf(child)
+                if leaf is not None:
+                    b = _chain_bytes(leaf)
+                    if b > 0:
+                        candidates.append((list(path), j, side, b))
+                elif (
+                    isinstance(child, HashJoinExec)
+                    and child.join_type == JoinType.INNER
+                    and child.filter is None
+                ):
+                    dfs(child, path + [(j, side)])
+
+        dfs(root, [])
+        if not candidates:
             raise UnsupportedOnDevice("no file-backed scan side")
-        fact_side, fact_leaf, _ = max(sides, key=lambda s: s[2])
-        self.fact_plan = join.left if fact_side == "left" else join.right
-        self.dim_plan = join.right if fact_side == "left" else join.left
-        left_n = len(join.left.schema())
-        fact_offset = 0 if fact_side == "left" else left_n
+        path, join, fact_side, _ = max(candidates, key=lambda c: c[3])
+        if join.filter is not None or len(join.on) != 1:
+            raise UnsupportedOnDevice("fact join shape (residual filter / multi-key)")
+        self.fact_plan = getattr(join, fact_side)
         fact_n = len(self.fact_plan.schema())
+        # joins between the root and the fact join run on the host over the
+        # dim plan; they must not need fact columns (q5 joins supplier on
+        # l_suppkey — that shape stays on the host path)
+        fact_names = set(self.fact_plan.schema().names)
+        for j, _side in path:
+            for ln, rn in j.on:
+                if ln in fact_names or rn in fact_names:
+                    raise UnsupportedOnDevice("upper join keyed on a fact column")
+        # offset of the fact block within the root's flattened schema
+        fact_offset = 0
+        for j, side in path + [(join, fact_side)]:
+            if side == "right":
+                fact_offset += len(j.left.schema())
         lkey, rkey = join.on[0]
         self.fact_key = lkey if fact_side == "left" else rkey
         self.dim_key = rkey if fact_side == "left" else lkey
         fact_key_idx = self.fact_plan.schema().names.index(self.fact_key)
 
-        # -- re-express aggregate exprs over the join schema -----------
-        join_schema = join.schema()
+        # -- dim plan: the join tree with the fact subtree removed ------
+        replacement = join.left if fact_side == "right" else join.right
+        for j, side in reversed(path):
+            children = [j.left, j.right]
+            children[0 if side == "left" else 1] = replacement
+            replacement = j.with_children(children)
+        self.dim_plan = replacement
+
+        # -- re-express aggregate exprs over the root join schema -------
+        join_schema = root.schema()
         mapping: List[px.PhysicalExpr] = [
             px.ColumnExpr(f.name, i) for i, f in enumerate(join_schema)
         ]
@@ -186,10 +230,13 @@ class FactAggregateStage:
             if s == "fact":
                 if not (isinstance(e, px.ColumnExpr) and e.index - fact_offset == fact_key_idx):
                     raise UnsupportedOnDevice("fact-side group key is not the join key")
-                self.group_layout.append(("factkey", name))
+                self.group_layout.append((FACT_KEY, name))
             elif s == "dim" and isinstance(e, px.ColumnExpr):
-                dim_idx = e.index - (0 if fact_side == "right" else left_n)
-                self.group_layout.append((self.dim_plan.schema().names[dim_idx], name))
+                ri = e.index if e.index < fact_offset else e.index - fact_n
+                dim_name = self.dim_plan.schema().names[ri]
+                if dim_name != e.name:
+                    raise UnsupportedOnDevice("dim column remap mismatch")
+                self.group_layout.append((dim_name, name))
             else:
                 raise UnsupportedOnDevice("unsupported group key shape")
 
@@ -235,11 +282,15 @@ class FactAggregateStage:
             self.partitions != 1
             or self.aggs[self.topk["agg_index"]].fn != "sum"
             or self.topk["k"] > (1 << 16)
+            or all(src is not FACT_KEY for src, _ in self.group_layout)
         ):
             # per-partition partial sums cannot drive a global top-k, the
-            # score must be a plain SUM state, and the candidate pool is
-            # capped at 64k groups; fall back to the member-select readback
-            # (still correct, larger d2h)
+            # score must be a plain SUM state, the candidate pool is capped
+            # at 64k groups, and — critically — the output groups must BE
+            # the fact keys: when the query groups by dim attributes only
+            # (q10 groups by customer), many keys fold into one group in the
+            # final merge and a per-key top-k ranks the wrong thing. Fall
+            # back to the member-select readback (still correct, larger d2h)
             self.topk = None
         self._dim_cache: Optional[dict] = None
         self._prepared: Dict[int, dict] = {}
@@ -473,7 +524,7 @@ class FactAggregateStage:
         fi = 0
         for src, _name in self.group_layout:
             f = fields[fi]
-            if src == "factkey":
+            if src is FACT_KEY:
                 arr = pa.array(ent["rank_keys"][ranks])
             else:
                 arr = dim_table.column(src).take(take_dim)

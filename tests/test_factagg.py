@@ -157,6 +157,53 @@ def test_topk_over_integer_sum(star):
     assert stages and stages[0].topk is not None
 
 
+def test_nested_dim_joins_group_by_dim_only(star, tmp_path):
+    """q10 shape: the fact is nested under TWO dim joins and the group keys
+    are all dim attributes (no fact key) — many fact keys fold into one
+    output group, so the top-k epilogue must disable itself and the select
+    path + final merge must produce the host answer."""
+    rng = np.random.default_rng(9)
+    # dimA: dk -> ck (FK into dimB); dimB: ck -> cattr. group by cattr only.
+    dimA = pa.table(
+        {
+            "dk": pa.array(np.arange(3000), type=pa.int64()),
+            "ck": pa.array(rng.integers(0, 50, 3000), type=pa.int64()),
+        }
+    )
+    dimB = pa.table(
+        {
+            "ck2": pa.array(np.arange(50), type=pa.int64()),
+            "cattr": pa.array([f"c{i}" for i in range(50)]),
+        }
+    )
+    pq.write_table(dimA, str(tmp_path / "dimA.parquet"))
+    pq.write_table(dimB, str(tmp_path / "dimB.parquet"))
+    sql = """
+        select cattr, sum(amount) as s, count(*) as n
+        from dimB, dimA, fact
+        where ck2 = ck and dk = fk
+        group by cattr
+        order by s desc
+        limit 12
+    """
+    kernels._stage_cache.clear()
+    outs = {}
+    for backend in ("tpu", "host"):
+        ctx = _ctx(backend, star)
+        ctx.register_parquet("dimA", str(tmp_path / "dimA.parquet"))
+        ctx.register_parquet("dimB", str(tmp_path / "dimB.parquet"))
+        outs[backend] = ctx.sql(sql).collect()
+    t, h = outs["tpu"], outs["host"]
+    np.testing.assert_allclose(
+        t.column("s").to_numpy(), h.column("s").to_numpy(), rtol=1e-4
+    )
+    assert t.column("n").to_pylist() == h.column("n").to_pylist()
+    assert t.column("cattr").to_pylist() == h.column("cattr").to_pylist()
+    stages = _factagg_stages()
+    assert stages, "nested fact pattern did not engage"
+    assert stages[0].topk is None  # group keys are dim-only
+
+
 def test_planner_annotates_topk(star):
     ctx = _ctx("host", star)
     df = ctx.sql(Q_TOPK)
