@@ -27,8 +27,9 @@ DATA = REPO / ".bench_cache" / f"tpch_sf{SF}"
 QUERIES_DIR = REPO / "benchmarks" / "tpch" / "queries"
 QUERY = (QUERIES_DIR / "q1.sql").read_text()
 BATCH = "16777216"
-# secondary configs reported to stderr (BASELINE.md configs 1 and 3)
-SIDE_QUERIES = ["q6", "q3"]
+# secondary configs reported to stderr (BASELINE.md configs 1, 3 and the
+# high-cardinality aggregate-over-join shape)
+SIDE_QUERIES = ["q6", "q3", "q10"]
 
 
 def ensure_data() -> None:

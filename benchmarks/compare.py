@@ -1,0 +1,224 @@
+"""Cross-engine comparison harness — the role the reference's Spark
+comparison plays (spark/benchmarks/src/main/scala/.../Main.scala:45-195:
+run the same TPC-H queries on a second engine for relative measurement).
+
+This image has no Spark/JVM, so the second engine is the strongest
+available independent baseline: pyarrow's own compute layer (hash
+group_by/join kernels in Arrow C++) driven directly, next to this
+framework's host backend and TPU backend. Each engine answers the same
+queries over the same parquet files; results are checked against each
+other before timings are reported.
+
+Usage:
+    python -m benchmarks.compare --data .bench_cache/tpch_sf1.0 \
+        --queries q1 q3 q6 [--iterations 3] [--engines tpu host pyarrow]
+
+Prints a markdown table of per-query best times and relative speed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import time
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+QUERIES_DIR = REPO / "benchmarks" / "tpch" / "queries"
+
+
+# -- engine: this framework (host or tpu backend) --------------------------
+
+
+class BallistaEngine:
+    def __init__(self, data: str, backend: str) -> None:
+        from ballista_tpu.config import BallistaConfig
+        from ballista_tpu.engine import ExecutionContext
+        from benchmarks.tpch.datagen import register_all
+
+        self.ctx = ExecutionContext(
+            BallistaConfig(
+                {
+                    "ballista.executor.backend": backend,
+                    "ballista.batch.size": "16777216",
+                }
+            )
+        )
+        register_all(self.ctx, data)
+
+    def run(self, name: str) -> pa.Table:
+        sql = (QUERIES_DIR / f"{name}.sql").read_text()
+        return self.ctx.sql(sql).collect()
+
+
+# -- engine: raw pyarrow (independent Arrow C++ baseline) ------------------
+
+
+class PyArrowEngine:
+    """Hand-written pyarrow implementations of the comparison queries —
+    independent of this framework's planner/operators, like the reference's
+    Spark implementations are independent of DataFusion."""
+
+    def __init__(self, data: str) -> None:
+        self.dir = pathlib.Path(data)
+        self._cache: Dict[str, pa.Table] = {}
+
+    def _t(self, name: str) -> pa.Table:
+        if name not in self._cache:
+            files = sorted((self.dir / name).glob("*.parquet"))
+            self._cache[name] = pa.concat_tables(pq.read_table(f) for f in files)
+        return self._cache[name]
+
+    def run(self, name: str) -> Optional[pa.Table]:
+        fn = getattr(self, f"_{name}", None)
+        return fn() if fn else None
+
+    def _q1(self) -> pa.Table:
+        import datetime
+
+        li = self._t("lineitem")
+        m = pc.less_equal(li.column("l_shipdate"), pa.scalar(datetime.date(1998, 9, 2)))
+        li = li.filter(m)
+        disc_price = pc.multiply(
+            li.column("l_extendedprice"), pc.subtract(pa.scalar(1.0), li.column("l_discount"))
+        )
+        charge = pc.multiply(disc_price, pc.add(pa.scalar(1.0), li.column("l_tax")))
+        t = li.append_column("disc_price", disc_price).append_column("charge", charge)
+        out = t.group_by(["l_returnflag", "l_linestatus"]).aggregate(
+            [
+                ("l_quantity", "sum"),
+                ("l_extendedprice", "sum"),
+                ("disc_price", "sum"),
+                ("charge", "sum"),
+                ("l_quantity", "mean"),
+                ("l_extendedprice", "mean"),
+                ("l_discount", "mean"),
+                ("l_quantity", "count"),
+            ]
+        )
+        return out.sort_by([("l_returnflag", "ascending"), ("l_linestatus", "ascending")])
+
+    def _q6(self) -> pa.Table:
+        import datetime
+
+        li = self._t("lineitem")
+        m = pc.and_(
+            pc.and_(
+                pc.greater_equal(li.column("l_shipdate"), pa.scalar(datetime.date(1994, 1, 1))),
+                pc.less(li.column("l_shipdate"), pa.scalar(datetime.date(1995, 1, 1))),
+            ),
+            pc.and_(
+                pc.and_(
+                    pc.greater_equal(li.column("l_discount"), pa.scalar(0.05)),
+                    pc.less_equal(li.column("l_discount"), pa.scalar(0.07)),
+                ),
+                pc.less(li.column("l_quantity"), pa.scalar(24.0)),
+            ),
+        )
+        li = li.filter(m)
+        rev = pc.sum(pc.multiply(li.column("l_extendedprice"), li.column("l_discount")))
+        return pa.table({"revenue": pa.array([rev.as_py()])})
+
+    def _q3(self) -> pa.Table:
+        import datetime
+
+        cutoff = datetime.date(1995, 3, 15)
+        cust = self._t("customer").filter(
+            pc.equal(self._t("customer").column("c_mktsegment"), pa.scalar("BUILDING"))
+        ).select(["c_custkey"])
+        orders = self._t("orders")
+        orders = orders.filter(
+            pc.less(orders.column("o_orderdate"), pa.scalar(cutoff))
+        ).select(["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"])
+        li = self._t("lineitem")
+        li = li.filter(pc.greater(li.column("l_shipdate"), pa.scalar(cutoff))).select(
+            ["l_orderkey", "l_extendedprice", "l_discount"]
+        )
+        j = orders.join(cust, keys="o_custkey", right_keys="c_custkey", join_type="inner")
+        j = li.join(j, keys="l_orderkey", right_keys="o_orderkey", join_type="inner")
+        rev = pc.multiply(
+            j.column("l_extendedprice"), pc.subtract(pa.scalar(1.0), j.column("l_discount"))
+        )
+        j = j.append_column("rev", rev)
+        out = j.group_by(["l_orderkey", "o_orderdate", "o_shippriority"]).aggregate(
+            [("rev", "sum")]
+        )
+        out = out.sort_by([("rev_sum", "descending"), ("o_orderdate", "ascending")])
+        return out.slice(0, 10)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=str(REPO / ".bench_cache" / "tpch_sf1.0"))
+    ap.add_argument("--queries", nargs="+", default=["q1", "q3", "q6"])
+    ap.add_argument("--iterations", type=int, default=3)
+    ap.add_argument("--engines", nargs="+", default=["tpu", "host", "pyarrow"])
+    args = ap.parse_args()
+
+    engines: Dict[str, object] = {}
+    for e in args.engines:
+        if e in ("tpu", "host"):
+            engines[e] = BallistaEngine(args.data, e)
+        elif e == "pyarrow":
+            engines[e] = PyArrowEngine(args.data)
+
+    rows = []
+    for q in args.queries:
+        results, times = {}, {}
+        for name, eng in engines.items():
+            out = eng.run(q)
+            if out is None:
+                continue
+            best = float("inf")
+            for _ in range(args.iterations):
+                t0 = time.perf_counter()
+                out = eng.run(q)
+                best = min(best, time.perf_counter() - t0)
+            results[name], times[name] = out, best
+        if not times:
+            print(f"{q}: no engine produced a result — skipped", file=sys.stderr)
+            continue
+        # cross-check row count and the first numeric column across engines
+        base_name = base_rows = base_vals = None
+        for name, out in results.items():
+            vals = None
+            for i, f in enumerate(out.schema):
+                if pa.types.is_floating(f.type):
+                    vals = np.sort(np.array(out.column(i), dtype=float))
+                    break
+            if base_name is None:
+                base_name, base_rows, base_vals = name, out.num_rows, vals
+                continue
+            if out.num_rows != base_rows:
+                print(f"WARNING: {q}: {name} rows={out.num_rows} != "
+                      f"{base_name} rows={base_rows}", file=sys.stderr)
+            elif (
+                vals is not None
+                and base_vals is not None
+                and not np.allclose(vals, base_vals, rtol=1e-3)
+            ):
+                print(f"WARNING: {q}: {name} values disagree with {base_name}",
+                      file=sys.stderr)
+        ref = times.get("host") or next(iter(times.values()))
+        rows.append((q, times, ref))
+
+    names = list(engines)
+    print("| query | " + " | ".join(f"{n} (ms)" for n in names) + " | best vs host |")
+    print("|" + "---|" * (len(names) + 2))
+    for q, times, ref in rows:
+        cells = [f"{times[n] * 1e3:.0f}" if n in times else "—" for n in names]
+        fastest = min(times, key=times.get)
+        print(f"| {q} | " + " | ".join(cells) +
+              f" | {fastest} {ref / times[fastest]:.2f}x |")
+
+
+if __name__ == "__main__":
+    main()
