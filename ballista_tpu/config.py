@@ -20,6 +20,8 @@ BALLISTA_BACKEND = "ballista.executor.backend"  # "cpu" (Arrow host kernels) | "
 BALLISTA_STAGE_FUSION = "ballista.tpu.stage_fusion"  # whole-stage SPMD compilation on/off
 BALLISTA_MESH_SHAPE = "ballista.tpu.mesh"  # e.g. "data:8" or "data:4,model:2"
 BALLISTA_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
+# compression for materialized shuffle pieces: "" (none) | "zstd" | "lz4"
+BALLISTA_SHUFFLE_CODEC = "ballista.shuffle.codec"
 BALLISTA_DEVICE_CACHE = "ballista.tpu.device_cache"  # keep encoded columns resident in HBM
 BALLISTA_SCAN_CACHE = "ballista.scan.cache"  # host-side decoded-table cache (parquet)
 BALLISTA_SCAN_CACHE_CAP = "ballista.scan.cache_cap_bytes"
@@ -41,6 +43,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_STAGE_FUSION: "true",
     BALLISTA_MESH_SHAPE: "data:1",
     BALLISTA_SHUFFLE_PARTITIONS: "16",
+    BALLISTA_SHUFFLE_CODEC: "",
     BALLISTA_DEVICE_CACHE: "true",
     BALLISTA_SCAN_CACHE: "true",
     BALLISTA_SCAN_CACHE_CAP: str(4 << 30),
@@ -79,6 +82,14 @@ class BallistaConfig(Mapping[str, str]):
 
     def stage_fusion(self) -> bool:
         return self._settings[BALLISTA_STAGE_FUSION].lower() in ("1", "true", "yes")
+
+    def shuffle_codec(self) -> str:
+        c = self._settings[BALLISTA_SHUFFLE_CODEC].strip().lower()
+        if c in ("", "none", "off"):
+            return ""
+        if c not in ("zstd", "lz4"):
+            raise ValueError(f"unsupported shuffle codec {c!r} (zstd|lz4)")
+        return c
 
     def shuffle_partitions(self) -> int:
         return int(self._settings[BALLISTA_SHUFFLE_PARTITIONS])

@@ -46,13 +46,22 @@ class PartitionStats:
         return f"PartitionStats(rows={self.num_rows}, batches={self.num_batches}, bytes={self.num_bytes})"
 
 
+def _ipc_options(codec: Optional[str]) -> Optional[pa.ipc.IpcWriteOptions]:
+    """Shuffle piece compression (ballista.shuffle.codec: "", zstd, lz4).
+    Readers decompress transparently — the frame carries the codec."""
+    if not codec:
+        return None
+    return pa.ipc.IpcWriteOptions(compression=codec)
+
+
 def write_stream_to_disk(
-    batches: Iterator[pa.RecordBatch], schema: pa.Schema, path: str
+    batches: Iterator[pa.RecordBatch], schema: pa.Schema, path: str,
+    codec: Optional[str] = None,
 ) -> PartitionStats:
     """Arrow IPC file writer with stats (ref utils.rs write_stream_to_disk)."""
     stats = PartitionStats()
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with pa.ipc.new_file(path, schema) as w:
+    with pa.ipc.new_file(path, schema, options=_ipc_options(codec)) as w:
         for b in batches:
             w.write_batch(b)
             stats.num_rows += b.num_rows
@@ -116,18 +125,20 @@ class ShuffleWriterExec(ExecutionPlan):
         schema = self.schema()
         pscheme = self.shuffle_output_partitioning
         total = PartitionStats()
+        codec = ctx.config.shuffle_codec()
         if pscheme is None:
             stats = write_stream_to_disk(
                 self.input.execute(partition, ctx), schema,
-                os.path.join(base, "0.arrow"),
+                os.path.join(base, "0.arrow"), codec=codec,
             )
             return stats
         n_out = pscheme.partition_count()
         writers = []
         os.makedirs(base, exist_ok=True)
+        opts = _ipc_options(codec)
         for m in range(n_out):
             sink = pa.OSFile(os.path.join(base, f"{m}.arrow"), "wb")
-            writers.append((sink, pa.ipc.new_file(sink, schema)))
+            writers.append((sink, pa.ipc.new_file(sink, schema, options=opts)))
         try:
             import numpy as np
 

@@ -141,8 +141,15 @@ class PollLoop:
             plan = phys_plan_from_proto(task.plan)
             if not isinstance(plan, ShuffleWriterExec):
                 plan = ShuffleWriterExec(pid.job_id, pid.stage_id, plan, None)
+            cfg = self.config
+            if task.settings:
+                # the submitting client's per-job settings override the
+                # executor's own defaults
+                cfg = BallistaConfig(
+                    {**cfg.to_dict(), **{kv.key: kv.value for kv in task.settings}}
+                )
             ctx = TaskContext(
-                config=self.config,
+                config=cfg,
                 work_dir=self.work_dir,
                 job_id=pid.job_id,
                 shuffle_fetcher=flight_shuffle_fetcher,

@@ -16,6 +16,15 @@ from ballista_tpu.proto import ballista_pb2 as pb
 
 SERVICE_NAME = "ballista.SchedulerGrpc"
 
+# serialized logical plans embed in-memory table data; gRPC's 4MB default
+# rejects them for anything but toy tables. 256MB matches the data sizes the
+# memory-scan path is meant for — file-backed scans ship only paths.
+_MAX_MSG = 256 * 1024 * 1024
+GRPC_MESSAGE_OPTIONS = [
+    ("grpc.max_send_message_length", _MAX_MSG),
+    ("grpc.max_receive_message_length", _MAX_MSG),
+]
+
 _METHODS = {
     "ExecuteQuery": (pb.ExecuteQueryParams, pb.ExecuteQueryResult),
     "PollWork": (pb.PollWorkParams, pb.PollWorkResult),
@@ -50,7 +59,9 @@ class SchedulerGrpcClient:
     """Client stub (plays the role of tonic's generated SchedulerGrpcClient)."""
 
     def __init__(self, host: str, port: int, channel: Optional[grpc.Channel] = None) -> None:
-        self.channel = channel or grpc.insecure_channel(f"{host}:{port}")
+        self.channel = channel or grpc.insecure_channel(
+            f"{host}:{port}", options=GRPC_MESSAGE_OPTIONS
+        )
         self._stubs = {}
         for name, (req_cls, resp_cls) in _METHODS.items():
             self._stubs[name] = self.channel.unary_unary(

@@ -86,6 +86,9 @@ class SchedulerServer:
         queued = pb.JobStatus()
         queued.queued.SetInParent()
         self.state.save_job_metadata(job_id, queued)
+        # per-job client settings ride TaskDefinition to executors (the
+        # reference drops its settings map, serde/scheduler/to_proto.rs:29-35)
+        self.state.save_job_settings(job_id, settings)
 
         if self.synchronous_planning:
             self._plan_job(job_id, plan, config)
@@ -146,6 +149,10 @@ class SchedulerServer:
 
                     result.task.task_id.CopyFrom(status.partition_id)
                     result.task.plan.CopyFrom(phys_plan_to_proto(plan))
+                    for k, v in self.state.get_job_settings(
+                        status.partition_id.job_id
+                    ).items():
+                        result.task.settings.add(key=k, value=v)
             for job_id in jobs:
                 self.state.synchronize_job_status(job_id)
             return result
@@ -179,7 +186,11 @@ class SchedulerServer:
 def serve(
     server_impl: SchedulerServer, bind_host: str = "0.0.0.0", port: int = 50050
 ) -> grpc.Server:
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+    from ballista_tpu.scheduler.rpc import GRPC_MESSAGE_OPTIONS
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=16), options=GRPC_MESSAGE_OPTIONS
+    )
     add_scheduler_service(server, server_impl)
     bound = server.add_insecure_port(f"{bind_host}:{port}")
     if bound == 0:

@@ -72,6 +72,22 @@ class SchedulerState:
         s.ParseFromString(v)
         return s
 
+    def save_job_settings(self, job_id: str, settings: Dict[str, str]) -> None:
+        """Client-supplied per-job settings, attached to every
+        TaskDefinition for this job so executors honor them."""
+        msg = pb.JobSettings()
+        for k, v in settings.items():
+            msg.settings.add(key=k, value=v)
+        self.kv.put(self._key("settings", job_id), msg.SerializeToString())
+
+    def get_job_settings(self, job_id: str) -> Dict[str, str]:
+        v = self.kv.get(self._key("settings", job_id))
+        if v is None:
+            return {}
+        msg = pb.JobSettings()
+        msg.ParseFromString(v)
+        return {kv.key: kv.value for kv in msg.settings}
+
     # -- stage plans ----------------------------------------------------------
     def save_stage_plan(self, job_id: str, stage_id: int, plan) -> None:
         msg = phys_plan_to_proto(plan)

@@ -74,7 +74,29 @@ def run_once(backend: str, sql: str = QUERY) -> float:
     return dt
 
 
+def _probe_device(timeout_s: int = 180) -> None:
+    """Fail fast (exit 3) when the TPU relay is unreachable: jax.devices()
+    otherwise blocks forever and the whole bench run hangs silently."""
+    import subprocess
+
+    code = "import jax; print(jax.devices())"
+    try:
+        subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s, check=True,
+            capture_output=True,
+        )
+    except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
+        tail = (e.stderr or b"").decode(errors="replace").strip().splitlines()[-3:]
+        print(
+            f"device backend unreachable ({e}); no benchmark possible\n"
+            + "\n".join(tail),
+            file=sys.stderr,
+        )
+        raise SystemExit(3)
+
+
 def main() -> None:
+    _probe_device()
     ensure_data()
     import pyarrow.parquet as pq
 

@@ -3,6 +3,7 @@ N executors + Flight data plane), mirroring the reference's docker-compose
 integration tests (dev/integration-tests.sh) without containers."""
 
 import logging
+import os
 
 import pyarrow as pa
 import pytest
@@ -49,6 +50,58 @@ def test_distributed_sql_with_limit(ctx):
         "order by s desc limit 2"
     ).collect()
     assert out.column("region").to_pylist() == ["west", "east"]
+
+
+def test_shuffle_compression_roundtrip(tmp_path):
+    """Shuffle pieces written with ballista.shuffle.codec=zstd read back
+    transparently (the IPC frame carries the codec), shrink on disk, and the
+    CLIENT-side setting actually reaches executor task execution."""
+    import glob
+
+    import numpy as np
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.distributed.stages import read_ipc_file, write_stream_to_disk
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    big = pa.table(
+        {
+            "k": pa.array(np.arange(20_000) % 64),
+            "txt": pa.array([f"compressible-payload-{i % 513}" for i in range(20_000)]),
+        }
+    )
+    path = str(tmp_path / "piece.arrow")
+    stats = write_stream_to_disk(iter(big.to_batches()), big.schema, path, codec="zstd")
+    assert stats.num_rows == big.num_rows
+    back = pa.Table.from_batches(list(read_ipc_file(path)))
+    assert back.equals(pa.Table.from_batches(big.to_batches()))
+
+    # end-to-end: the codec travels client -> scheduler -> TaskDefinition ->
+    # executor; prove it engaged by comparing materialized piece bytes
+    sizes = {}
+    for codec in ("", "zstd"):
+        cluster = StandaloneCluster(n_executors=1, config=BallistaConfig())
+        try:
+            host, port = cluster.scheduler_addr
+            c = BallistaContext(host, port,
+                                settings={"ballista.shuffle.codec": codec})
+            c.register_record_batches("big", big, n_partitions=2)
+            out = (
+                c.sql("select k, count(*) as n, txt from big group by k, txt "
+                      "order by k, txt")
+                .collect()
+            )
+            # (i%64, i%513) pairs are all distinct below lcm(64,513)=32832
+            assert out.num_rows == 20_000
+            wd = cluster.executors[0].work_dir
+            sizes[codec or "none"] = sum(
+                os.path.getsize(f)
+                for f in glob.glob(wd + "/**/*.arrow", recursive=True)
+            )
+            c.close()
+        finally:
+            cluster.shutdown()
+    assert sizes["zstd"] < sizes["none"] * 0.9, sizes
 
 
 def test_distributed_filter_projection(ctx):
