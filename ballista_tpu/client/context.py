@@ -73,7 +73,9 @@ class BallistaContext(ExecutionContext):
     def collect(self, plan: lp.LogicalPlan, timeout: float = 300.0) -> pa.Table:
         params = pb.ExecuteQueryParams()
         params.logical_plan.CopyFrom(plan_to_proto(plan))
-        for k, v in self.config.items():
+        # only non-default settings travel: they override scheduler/executor
+        # configs per job without clobbering host-local tuning
+        for k, v in self.config.explicit_settings().items():
             params.settings.add(key=k, value=v)
         job_id = self._client.execute_query(params).job_id
         status = self._wait_for_job(job_id, timeout)

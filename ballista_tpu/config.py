@@ -126,6 +126,17 @@ class BallistaConfig(Mapping[str, str]):
             out[name.strip()] = int(n)
         return out
 
+    def explicit_settings(self) -> Dict[str, str]:
+        """Settings that differ from the defaults — what a client should
+        transmit per job so it overrides only what the user actually set
+        (sending the full map would clobber executor-local tuning with
+        client-side defaults)."""
+        return {
+            k: v
+            for k, v in self._settings.items()
+            if DEFAULT_SETTINGS.get(k) != v
+        }
+
     def with_setting(self, key: str, value: str) -> "BallistaConfig":
         s = dict(self._settings)
         s[key] = value

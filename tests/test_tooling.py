@@ -39,6 +39,60 @@ def test_dbapi_error():
         conn.cursor().execute("select * from nonexistent")
 
 
+def test_dbapi_type_mapping_and_metadata(sales_table):
+    """PEP 249 type objects, description matrix, catalog metadata — the
+    JDBC driver's FlightResultSetMetaData / DatabaseMetaData roles."""
+    import ballista_tpu.client.dbapi as db
+
+    with db.connect(local=True) as conn:
+        conn.context.register_record_batches("sales", sales_table)
+        assert conn.get_tables() == ["sales"]
+        cols = dict((c[0], c) for c in conn.get_columns("sales"))
+        assert cols["region"][1] == db.STRING
+        assert cols["amount"][1] == db.NUMBER
+        with pytest.raises(db.ProgrammingError):
+            conn.get_columns("nope")
+
+        with conn.cursor() as cur:
+            cur.execute("select region, amount, qty from sales limit 1")
+            d = {c[0]: c for c in cur.description}
+            assert d["region"][1] == db.STRING and d["region"][1] != db.NUMBER
+            assert d["amount"][1] == db.NUMBER
+            assert d["amount"][4] == 15  # double precision digits
+            assert d["qty"][3] == 4  # int32 internal size
+
+
+def test_dbapi_parameter_binding(sales_table):
+    """qmark binding must not touch '?' inside string literals and must
+    reject arity mismatches (PreparedStatement analog)."""
+    import ballista_tpu.client.dbapi as db
+
+    conn = db.connect(local=True)
+    conn.context.register_record_batches("sales", sales_table)
+    cur = conn.cursor()
+    cur.execute(
+        "select count(*) as n from sales where region != 'what?' and amount > ?",
+        (100,),
+    )
+    assert cur.fetchone() == (0,)
+    with pytest.raises(db.ProgrammingError):
+        cur.execute("select ? + 1", ())
+    with pytest.raises(db.ProgrammingError):
+        cur.execute("select 1", (5,))
+    with pytest.raises(db.ProgrammingError):
+        cur.execute("select ?", (object(),))
+    # '?' inside comments and quoted identifiers must not bind
+    from ballista_tpu.client.dbapi import _bind
+
+    assert _bind("select a -- total?\nfrom t where id = ?", [7]).endswith("id = 7")
+    assert "?" in _bind("select a /* what? */ from t where id = ?", [7]).split("*/")[0]
+    assert _bind('select "a?b" from t where id = ?', [7]).startswith('select "a?b"')
+    # Decimal parameters bind as exact decimal text
+    import decimal
+
+    assert _bind("select ?", [decimal.Decimal("10.50")]) == "select 10.50"
+
+
 def test_daemon_config_precedence(tmp_path, monkeypatch):
     from ballista_tpu.daemon_config import SCHEDULER_SPEC, load_config
 
