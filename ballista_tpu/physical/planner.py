@@ -123,6 +123,25 @@ class PhysicalPlanner:
         if isinstance(plan, lp.Filter):
             input = self._plan(plan.input)
             pred = create_physical_expr(plan.predicate, input.schema())
+            # hint the scan so provably-empty parquet row groups are
+            # skipped (statistics pruning; the filter itself still runs)
+            target = input
+            if isinstance(target, ProjectionExec) and all(
+                isinstance(e, ColumnExpr) for e, _ in target.exprs
+            ):
+                target = target.input
+            if isinstance(target, ParquetScanExec) and target.prune_predicate is None:
+                from ballista_tpu.ops.stage import substitute_columns
+
+                try:
+                    if target is input:
+                        target.prune_predicate = pred
+                    else:
+                        # rebase through the rename-only projection
+                        mapping = [e for e, _ in input.exprs]
+                        target.prune_predicate = substitute_columns(pred, mapping)
+                except Exception:
+                    pass  # pruning is best-effort; the filter is authoritative
             return FilterExec(input, pred)
         if isinstance(plan, lp.Aggregate):
             return self._plan_aggregate(plan)

@@ -101,6 +101,11 @@ class ParquetScanExec(ExecutionPlan):
             self._schema = full
         else:
             self._schema = pa.schema([full.field(i) for i in projection])
+        # best-effort predicate hint set by the physical planner when a
+        # FilterExec sits directly above: row groups whose min/max statistics
+        # prove no row can match are skipped on the streaming path. The
+        # filter above still runs, so this is purely an IO reduction.
+        self.prune_predicate = None
 
     def schema(self) -> pa.Schema:
         return self._schema
@@ -124,11 +129,105 @@ class ParquetScanExec(ExecutionPlan):
             yield from table.to_batches(max_chunksize=ctx.batch_size)
             return
         pf = pa.parquet.ParquetFile(path)
-        for batch in pf.iter_batches(batch_size=ctx.batch_size, columns=cols):
+        row_groups = prune_row_groups(pf, self.prune_predicate)
+        if not row_groups:
+            return
+        for batch in pf.iter_batches(
+            batch_size=ctx.batch_size, columns=cols, row_groups=row_groups
+        ):
             yield batch
 
     def fmt(self) -> str:
         return f"ParquetScanExec: {self.source.path} projection={self.projection}"
+
+
+def _stat_conjuncts(predicate) -> List[tuple]:
+    """Extract (column name, op, literal) conjuncts usable against row-group
+    statistics; unrecognized parts are ignored (conservative)."""
+    from ballista_tpu.physical import expr as px
+
+    out: List[tuple] = []
+
+    def walk(e) -> None:
+        if isinstance(e, px.BinaryPhysicalExpr):
+            if e.op == "and":
+                walk(e.left)
+                walk(e.right)
+                return
+            flipped = {"lt": "gt", "lteq": "gteq", "gt": "lt", "gteq": "lteq",
+                       "eq": "eq"}
+            if e.op in flipped:
+                l, r = e.left, e.right
+                if isinstance(l, px.ColumnExpr) and isinstance(r, px.LiteralExpr):
+                    out.append((l.name, e.op, r.value))
+                elif isinstance(l, px.LiteralExpr) and isinstance(r, px.ColumnExpr):
+                    out.append((r.name, flipped[e.op], l.value))
+        elif isinstance(e, px.BetweenExpr) and not e.negated:
+            if (
+                isinstance(e.expr, px.ColumnExpr)
+                and isinstance(e.low, px.LiteralExpr)
+                and isinstance(e.high, px.LiteralExpr)
+            ):
+                out.append((e.expr.name, "gteq", e.low.value))
+                out.append((e.expr.name, "lteq", e.high.value))
+
+    walk(predicate)
+    return out
+
+
+def prune_row_groups(pf, predicate) -> List[int]:
+    """Row groups that might contain matching rows (all of them when the
+    predicate is absent or statistics are unusable). Mirrors the reference
+    engine's parquet row-group filtering role; the proof obligation is
+    one-sided — a group is skipped only when its min/max make a conjunct
+    unsatisfiable."""
+    md = pf.metadata
+    n = md.num_row_groups
+    if predicate is None or n == 0:
+        return list(range(n))
+    conjuncts = _stat_conjuncts(predicate)
+    if not conjuncts:
+        return list(range(n))
+    # metadata columns are flattened parquet LEAVES, not arrow fields —
+    # indexing by arrow-schema position shifts under nested columns and
+    # would consult the wrong statistics. Map by leaf path instead; only
+    # top-level primitive columns (path == name) participate.
+    rg0 = md.row_group(0)
+    file_cols = {}
+    for i in range(md.num_columns):
+        p = rg0.column(i).path_in_schema
+        if "." not in p:
+            file_cols[p] = i
+    keep: List[int] = []
+    for g in range(n):
+        rg = md.row_group(g)
+        dead = False
+        for name, op, lit in conjuncts:
+            ci = file_cols.get(name)
+            if ci is None or lit is None:
+                continue
+            col = rg.column(ci)
+            st = col.statistics
+            if st is None or not st.has_min_max:
+                continue
+            try:
+                if op == "lt" and not (st.min < lit):
+                    dead = True
+                elif op == "lteq" and not (st.min <= lit):
+                    dead = True
+                elif op == "gt" and not (st.max > lit):
+                    dead = True
+                elif op == "gteq" and not (st.max >= lit):
+                    dead = True
+                elif op == "eq" and not (st.min <= lit <= st.max):
+                    dead = True
+            except TypeError:
+                continue  # incomparable stats (e.g. binary vs py value)
+            if dead:
+                break
+        if not dead:
+            keep.append(g)
+    return keep
 
 
 class MemoryScanExec(ExecutionPlan):

@@ -115,6 +115,9 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         if plan.projection is not None:
             n.scan.scan.has_projection = True
             n.scan.scan.projection.extend(plan.projection)
+        prune = getattr(plan, "prune_predicate", None)
+        if prune is not None:
+            n.scan.prune_predicate.CopyFrom(expr_to_proto(uncompile_expr(prune)))
     elif isinstance(plan, ProjectionExec):
         n.projection.input.CopyFrom(phys_plan_to_proto(plan.input))
         for e, name in plan.exprs:
@@ -248,7 +251,12 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
         if isinstance(src, CsvTableSource):
             return CsvScanExec(src, projection)
         if isinstance(src, ParquetTableSource):
-            return ParquetScanExec(src, projection)
+            scan = ParquetScanExec(src, projection)
+            if n.scan.HasField("prune_predicate"):
+                scan.prune_predicate = create_physical_expr(
+                    expr_from_proto(n.scan.prune_predicate), scan.schema()
+                )
+            return scan
         return MemoryScanExec(src, projection)
     if which == "spmd_aggregate":
         return SpmdAggregateExec(phys_plan_from_proto(n.spmd_aggregate.subplan))

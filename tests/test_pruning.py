@@ -1,0 +1,161 @@
+"""Parquet row-group statistics pruning (ParquetScanExec.prune_predicate)."""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.logical import col, lit
+from ballista_tpu.physical.scan import ParquetScanExec, prune_row_groups
+
+
+@pytest.fixture
+def sorted_parquet(tmp_path):
+    """1M rows sorted by k in 10 row groups of 100k (k in [g*100, g*100+100))."""
+    n = 1_000_000
+    k = np.sort(np.random.default_rng(0).integers(0, 1000, n))
+    t = pa.table({"k": pa.array(k, type=pa.int64()),
+                  "v": pa.array(np.random.default_rng(1).uniform(0, 1, n))})
+    p = tmp_path / "sorted.parquet"
+    pq.write_table(t, str(p), row_group_size=100_000)
+    return str(p), t
+
+
+def test_prune_row_groups_skips_disjoint(sorted_parquet):
+    from ballista_tpu.physical import expr as px
+
+    path, t = sorted_parquet
+    pf = pq.ParquetFile(path)
+    assert pf.metadata.num_row_groups == 10
+    schema = t.schema
+
+    pred = px.BinaryPhysicalExpr(
+        px.ColumnExpr("k", 0), "lt", px.LiteralExpr(150, pa.int64())
+    )
+    keep = prune_row_groups(pf, pred)
+    assert keep and len(keep) < 10  # only the low-k groups survive
+
+    pred2 = px.BetweenExpr(
+        px.ColumnExpr("k", 0),
+        px.LiteralExpr(400, pa.int64()),
+        px.LiteralExpr(450, pa.int64()),
+        False,
+    )
+    keep2 = prune_row_groups(pf, pred2)
+    assert keep2 and len(keep2) <= 2
+
+    # no predicate / unprunable predicate -> all groups
+    assert prune_row_groups(pf, None) == list(range(10))
+    pred3 = px.BinaryPhysicalExpr(
+        px.ColumnExpr("v", 1), "plus", px.LiteralExpr(1.0, pa.float64())
+    )
+    assert prune_row_groups(pf, pred3) == list(range(10))
+
+
+def test_pruned_query_matches_unpruned(sorted_parquet, tmp_path):
+    """End-to-end: the planner attaches the hint on the streaming path and
+    results are identical with pruning on and off."""
+    path, t = sorted_parquet
+    outs = {}
+    for cache in ("true", "false"):  # false -> streaming path (pruned)
+        ctx = ExecutionContext(BallistaConfig({"ballista.scan.cache": cache}))
+        ctx.register_parquet("t", path)
+        outs[cache] = ctx.sql(
+            "select count(*) as n, sum(v) as s from t where k >= 400 and k < 450"
+        ).collect()
+    assert outs["true"].column("n").to_pylist() == outs["false"].column("n").to_pylist()
+    np.testing.assert_allclose(
+        outs["true"].column("s").to_numpy(), outs["false"].column("s").to_numpy(),
+        rtol=1e-9,
+    )
+
+    # the physical plan actually carries the hint
+    ctx = ExecutionContext(BallistaConfig())
+    ctx.register_parquet("t", path)
+    df = ctx.sql("select v from t where k < 100")
+    phys = ctx.create_physical_plan(df.logical_plan())
+
+    def find(n):
+        if isinstance(n, ParquetScanExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    scan = find(phys)
+    assert scan is not None and scan.prune_predicate is not None
+
+
+def test_prune_date_column(tmp_path):
+    """Date32 statistics compare correctly against python date literals."""
+    days = [datetime.date(2024, 1, 1) + datetime.timedelta(days=i) for i in range(100)]
+    t = pa.table({"d": pa.array(days), "x": pa.array(range(100))})
+    p = tmp_path / "dates.parquet"
+    pq.write_table(t, str(p), row_group_size=25)
+
+    from ballista_tpu.physical import expr as px
+
+    pf = pq.ParquetFile(str(p))
+    pred = px.BinaryPhysicalExpr(
+        px.ColumnExpr("d", 0), "lt",
+        px.LiteralExpr(datetime.date(2024, 1, 20), pa.date32()),
+    )
+    keep = prune_row_groups(pf, pred)
+    assert keep == [0]
+
+
+def test_prune_nested_schema_columns(tmp_path):
+    """Metadata columns are flattened leaves: a nested column before the
+    predicate column must not shift which statistics are consulted
+    (review regression: wrong stats could silently drop matching rows)."""
+    t = pa.table({
+        "s": pa.array([{"a": 1, "b": 2}] * 100),
+        "x": pa.array(range(100, 200), type=pa.int64()),
+    })
+    p = tmp_path / "nested.parquet"
+    pq.write_table(t, str(p), row_group_size=50)
+
+    from ballista_tpu.physical import expr as px
+
+    pf = pq.ParquetFile(str(p))
+    pred = px.BinaryPhysicalExpr(
+        px.ColumnExpr("x", 1), "gt", px.LiteralExpr(50, pa.int64())
+    )
+    # x in [100, 200) > 50 everywhere: nothing may be pruned
+    assert prune_row_groups(pf, pred) == [0, 1]
+    pred2 = px.BinaryPhysicalExpr(
+        px.ColumnExpr("x", 1), "lt", px.LiteralExpr(150, pa.int64())
+    )
+    assert prune_row_groups(pf, pred2) == [0]
+
+
+def test_prune_predicate_survives_serde(sorted_parquet):
+    """The hint ships to executors (scheduler -> TaskDefinition plan)."""
+    from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
+
+    path, _ = sorted_parquet
+    ctx = ExecutionContext(BallistaConfig())
+    ctx.register_parquet("t", path)
+    df = ctx.sql("select v from t where k < 100")
+    phys = ctx.create_physical_plan(df.logical_plan())
+    back = phys_plan_from_proto(phys_plan_to_proto(phys))
+
+    def find(n):
+        if isinstance(n, ParquetScanExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    scan = find(back)
+    assert scan is not None and scan.prune_predicate is not None
+    pf = pq.ParquetFile(path)
+    assert len(prune_row_groups(pf, scan.prune_predicate)) < pf.metadata.num_row_groups
