@@ -703,8 +703,13 @@ WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank") + AGGREGATE_FUNCTIONS
 
 
 class WindowExpr(Expr):
-    """fn(...) OVER (PARTITION BY ... ORDER BY ...). Frame is always the
-    whole partition (unbounded) — the common analytic surface."""
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [ROWS frame]).
+
+    frame: None = the SQL default (whole partition without ORDER BY;
+    UNBOUNDED PRECEDING..CURRENT ROW with it), else a (start, end) pair of
+    ROWS offsets relative to the current row — None = unbounded on that
+    side, negative = PRECEDING, 0 = CURRENT ROW, positive = FOLLOWING.
+    RANGE frames are not supported."""
 
     def __init__(
         self,
@@ -712,14 +717,22 @@ class WindowExpr(Expr):
         arg: Optional["Expr"],
         partition_by: List["Expr"],
         order_by: List["SortExpr"],
+        frame: Optional[Tuple[Optional[int], Optional[int]]] = None,
     ) -> None:
         fn = fn.lower()
         if fn not in WINDOW_FUNCTIONS:
             raise PlanError(f"unknown window function {fn!r}")
+        if frame is not None:
+            start, end = frame
+            if fn in ("row_number", "rank", "dense_rank"):
+                raise PlanError(f"{fn} does not accept a frame clause")
+            if start is not None and end is not None and start > end:
+                raise PlanError("window frame start is after its end")
         self.fn = fn
         self.arg = arg
         self.partition_by = partition_by
         self.order_by = order_by
+        self.frame = frame
 
     def data_type(self, schema: pa.Schema) -> pa.DataType:
         if self.fn in ("row_number", "rank", "dense_rank", "count"):
@@ -753,7 +766,18 @@ class WindowExpr(Expr):
             parts.append("PARTITION BY " + ", ".join(str(e) for e in self.partition_by))
         if self.order_by:
             parts.append("ORDER BY " + ", ".join(str(e) for e in self.order_by))
+        if self.frame is not None:
+            parts.append(f"ROWS BETWEEN {_bound(self.frame[0], True)} "
+                         f"AND {_bound(self.frame[1], False)}")
         return f"{self.fn.upper()}({arg}) OVER ({' '.join(parts)})"
+
+
+def _bound(b: Optional[int], is_start: bool) -> str:
+    if b is None:
+        return "UNBOUNDED PRECEDING" if is_start else "UNBOUNDED FOLLOWING"
+    if b == 0:
+        return "CURRENT ROW"
+    return f"{-b} PRECEDING" if b < 0 else f"{b} FOLLOWING"
 
 
 class SortExpr(Expr):

@@ -226,6 +226,7 @@ class PhysicalPlanner:
                     ],
                     e.output_name(),
                     e.data_type(in_schema),
+                    w.frame,
                 )
             )
         return WindowExec(input, funcs)

@@ -1,12 +1,14 @@
 """Window function execution.
 
-WindowExec computes analytic functions over full partitions (unbounded
-frame): row_number / rank / dense_rank and the five aggregates. Strategy:
-merge to one partition, sort by (partition keys, order keys), compute
-partition boundaries once, then every function is a vectorized pass —
-cumcounts for ranking, segment-aggregate + broadcast-back for aggregates.
-Output rows come back in sorted order (row order is unspecified unless the
-query adds ORDER BY).
+WindowExec computes row_number / rank / dense_rank and the five aggregates
+with SQL frame semantics: whole-partition when no ORDER BY is given, the
+standard peer-inclusive running frame (RANGE UNBOUNDED PRECEDING..CURRENT
+ROW) with ORDER BY, and explicit ROWS BETWEEN frames. Strategy: merge to
+one partition, sort by (partition keys, order keys), compute partition/peer
+boundaries once, then every function is a vectorized pass — cumcounts for
+ranking, prefix sums / accumulates (plus padded sliding windows for bounded
+min/max) for aggregates. Output rows come back in sorted order (row order
+is unspecified unless the query adds ORDER BY).
 """
 
 from __future__ import annotations
@@ -37,6 +39,7 @@ class WindowFuncDesc:
         order_by: List[Tuple[PhysicalExpr, bool]],  # (expr, ascending)
         name: str,
         dtype: pa.DataType,
+        frame: Optional[Tuple[Optional[int], Optional[int]]] = None,
     ) -> None:
         self.fn = fn
         self.arg = arg
@@ -44,6 +47,9 @@ class WindowFuncDesc:
         self.order_by = order_by
         self.name = name
         self.dtype = dtype
+        # ROWS frame (start, end) offsets; None side = unbounded; the whole
+        # tuple None = SQL default (resolved at execution)
+        self.frame = frame
 
 
 def _codes(arr: pa.Array) -> np.ndarray:
@@ -146,7 +152,9 @@ class WindowExec(ExecutionPlan):
                 vals = dense - base + 1
             return pa.array(vals[inv], type=pa.int64())
 
-        # partition aggregates
+        # aggregates: whole-partition (no ORDER BY), the standard
+        # peer-inclusive running frame (ORDER BY, no explicit frame — RANGE
+        # UNBOUNDED PRECEDING..CURRENT ROW), or an explicit ROWS frame.
         assert f.arg is not None or f.fn == "count"
         if f.arg is not None:
             argv = _as_array(f.arg.evaluate(batch), n)
@@ -155,29 +163,144 @@ class WindowExec(ExecutionPlan):
         else:
             av = np.ones(n, dtype=np.float64)
             valid = np.ones(n, dtype=bool)
+        frame = f.frame
+        peers_hi = None
+        if frame is None:
+            if f.order_by:
+                frame = (None, 0)
+                # RANGE default: rows tied on the order keys are peers and
+                # every peer sees the same (full peer-run) value
+                ocodes = np.zeros(n, dtype=np.int64)
+                for i in range(len(f.order_by)):
+                    c = _codes(sort_cols[f"__o{i}"])[order]
+                    ocodes = ocodes * (int(c.max()) + 1 if len(c) else 1) + c
+                changed = np.ones(n, dtype=bool)
+                changed[1:] = (ocodes[1:] != ocodes[:-1]) | new_part[1:]
+                run_starts = np.flatnonzero(changed)
+                nxt = np.append(run_starts[1:], n)
+                peers_hi = nxt[np.cumsum(changed) - 1]
+            else:
+                frame = (None, None)
         nparts = int(part_id[-1]) + 1
-        if f.fn == "count":
-            agg = np.zeros(nparts)
-            np.add.at(agg, part_id, valid.astype(np.float64))
-        elif f.fn in ("sum", "avg"):
-            agg = np.zeros(nparts)
-            np.add.at(agg, part_id, np.where(valid, av, 0.0))
-            if f.fn == "avg":
-                cnt = np.zeros(nparts)
-                np.add.at(cnt, part_id, valid.astype(np.float64))
-                agg = agg / np.maximum(cnt, 1)
-        elif f.fn == "min":
-            agg = np.full(nparts, np.inf)
-            np.minimum.at(agg, part_id, np.where(valid, av, np.inf))
-        elif f.fn == "max":
-            agg = np.full(nparts, -np.inf)
-            np.maximum.at(agg, part_id, np.where(valid, av, -np.inf))
-        else:
-            raise PlanError(f"unsupported window function {f.fn}")
-        vals = agg[part_id][inv]
-        return pc.cast(pa.array(vals), f.dtype)
+        if frame == (None, None):
+            cnt = np.zeros(nparts)
+            np.add.at(cnt, part_id, valid.astype(np.float64))
+            if f.fn == "count":
+                vals = cnt[part_id][inv]
+                return pc.cast(pa.array(vals), f.dtype)
+            if f.fn in ("sum", "avg"):
+                agg = np.zeros(nparts)
+                np.add.at(agg, part_id, np.where(valid, av, 0.0))
+                if f.fn == "avg":
+                    agg = agg / np.maximum(cnt, 1)
+            elif f.fn == "min":
+                agg = np.full(nparts, np.inf)
+                np.minimum.at(agg, part_id, np.where(valid, av, np.inf))
+            elif f.fn == "max":
+                agg = np.full(nparts, -np.inf)
+                np.maximum.at(agg, part_id, np.where(valid, av, -np.inf))
+            else:
+                raise PlanError(f"unsupported window function {f.fn}")
+            vals = agg[part_id][inv]
+            # a partition with no valid input rows aggregates to NULL
+            empty = (cnt == 0)[part_id][inv]
+            return pc.cast(pa.array(vals, mask=empty), f.dtype)
+        vals, null_mask = _framed_aggregate(
+            f.fn, av, valid, part_start, part_id, new_part, frame, peers_hi
+        )
+        arr = pa.array(vals[inv], mask=null_mask[inv] if null_mask is not None else None)
+        return pc.cast(arr, f.dtype)
 
     def fmt(self) -> str:
         return "WindowExec: " + ", ".join(
             f"{f.fn}(...) AS {f.name}" for f in self.funcs
         )
+
+
+def _framed_aggregate(
+    fn: str,
+    av: np.ndarray,
+    valid: np.ndarray,
+    part_start: np.ndarray,
+    part_id: np.ndarray,
+    new_part: np.ndarray,
+    frame,
+    peers_hi: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Framed aggregates over rows already sorted by (partition keys, order
+    keys). Per row i the window is rows [i+start, i+end] clamped to its
+    partition — or, when peers_hi is given (the RANGE running default), rows
+    [partition start, peers_hi[i]). sum/count/avg vectorize via prefix sums
+    (windows never cross partition bounds, so one global prefix array
+    suffices); min/max run per partition with accumulate / padded sliding
+    windows. Returns (values, null mask for empty windows)."""
+    n = len(av)
+    start, end = frame
+    # per-row partition bounds [ps, pe)
+    starts_idx = np.flatnonzero(new_part)
+    ends = np.append(starts_idx[1:], n)
+    ps = part_start
+    pe = ends[part_id]
+    idx = np.arange(n)
+    if peers_hi is not None:
+        lo, hi = ps, peers_hi
+    else:
+        lo = ps if start is None else np.clip(idx + start, ps, pe)
+        hi = pe if end is None else np.clip(idx + end + 1, ps, pe)
+        hi = np.maximum(hi, lo)  # empty window
+
+    if fn in ("sum", "avg", "count"):
+        pref = np.concatenate([[0.0], np.cumsum(np.where(valid, av, 0.0))])
+        prefc = np.concatenate([[0.0], np.cumsum(valid.astype(np.float64))])
+        s = pref[hi] - pref[lo]
+        c = prefc[hi] - prefc[lo]
+        if fn == "count":
+            return c, None
+        if fn == "avg":
+            return s / np.maximum(c, 1), (c == 0)
+        return s, (c == 0)
+
+    if fn not in ("min", "max"):
+        raise PlanError(f"unsupported framed window function {fn}")
+    fill = np.inf if fn == "min" else -np.inf
+    acc = np.minimum.accumulate if fn == "min" else np.maximum.accumulate
+    v = np.where(valid, av, fill)
+    out = np.empty(n, dtype=np.float64)
+    for s0, e0 in zip(starts_idx, ends):
+        seg = v[s0:e0]
+        m = len(seg)
+        iseg = np.arange(m)
+        if peers_hi is not None:
+            run = acc(seg)
+            out[s0:e0] = run[peers_hi[s0:e0] - 1 - s0]
+            continue
+        # clamp offsets to the segment so a huge frame bound costs O(m),
+        # not O(bound)
+        cs = None if start is None else max(start, -m)
+        ce = None if end is None else min(end, m)
+        if cs is None:
+            run = acc(seg)
+            out[s0:e0] = run[np.clip(iseg + ce, 0, m - 1)]
+            if ce < 0:  # first rows have empty windows
+                out[s0:e0][iseg + ce < 0] = fill
+        elif ce is None:
+            run = acc(seg[::-1])[::-1]
+            out[s0:e0] = run[np.clip(iseg + cs, 0, m - 1)]
+            if cs > 0:
+                out[s0:e0][iseg + cs > m - 1] = fill
+        else:
+            w = ce - cs + 1
+            pad_before = -min(cs, 0)
+            padded = np.concatenate(
+                [np.full(pad_before, fill), seg,
+                 np.full(max(ce, 0) + max(cs, 0), fill)]
+            )
+            # window for row i starts at padded[i + cs + pad_before]
+            view = np.lib.stride_tricks.sliding_window_view(padded, w)
+            sel = view[iseg + cs + pad_before]
+            out[s0:e0] = sel.min(axis=1) if fn == "min" else sel.max(axis=1)
+    # rows whose frame holds no (valid) rows are NULL per SQL (the fill
+    # sentinel survives only when nothing real entered the window; genuine
+    # +-inf inputs in an otherwise-real window are indistinguishable — a
+    # documented corner)
+    return out, out == fill

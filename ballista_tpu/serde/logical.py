@@ -90,6 +90,28 @@ def scalar_from_proto(s: pb.ScalarValue):
 # ---------------------------------------------------------------------------
 
 
+def frame_to_proto(msg: "pb.WindowFrameNode", frame) -> None:
+    """One encode/decode pair for WindowFrameNode, shared by the logical and
+    physical serde (the frame tuple semantics live in lx.WindowExpr)."""
+    start, end = frame
+    msg.SetInParent()
+    if start is None:
+        msg.start_unbounded = True
+    else:
+        msg.start = start
+    if end is None:
+        msg.end_unbounded = True
+    else:
+        msg.end = end
+
+
+def frame_from_proto(msg: "pb.WindowFrameNode"):
+    return (
+        None if msg.start_unbounded else msg.start,
+        None if msg.end_unbounded else msg.end,
+    )
+
+
 def expr_to_proto(e: lx.Expr) -> pb.LogicalExprNode:
     n = pb.LogicalExprNode()
     if isinstance(e, lx.Column):
@@ -163,6 +185,8 @@ def expr_to_proto(e: lx.Expr) -> pb.LogicalExprNode:
             n.window_expr.partition_by.append(expr_to_proto(pe))
         for oe in e.order_by:
             n.window_expr.order_by.append(expr_to_proto(oe))
+        if e.frame is not None:
+            frame_to_proto(n.window_expr.frame, e.frame)
     elif isinstance(e, lx.SortExpr):
         n.sort_expr.expr.CopyFrom(expr_to_proto(e.expr))
         n.sort_expr.ascending = e.ascending
@@ -268,8 +292,10 @@ def expr_from_proto(n: pb.LogicalExprNode) -> lx.Expr:
             se = expr_from_proto(oe)
             assert isinstance(se, lx.SortExpr)
             order.append(se)
+        frame = frame_from_proto(w.frame) if w.HasField("frame") else None
         return lx.WindowExpr(
-            w.fn, arg, [expr_from_proto(pe) for pe in w.partition_by], order
+            w.fn, arg, [expr_from_proto(pe) for pe in w.partition_by], order,
+            frame,
         )
     raise SerdeError(f"empty expr node {n}")
 

@@ -46,6 +46,8 @@ from ballista_tpu.proto import ballista_pb2 as pb
 from ballista_tpu.serde.logical import (
     expr_from_proto,
     expr_to_proto,
+    frame_from_proto,
+    frame_to_proto,
     scalar_from_proto,
     scalar_to_proto,
     source_from_proto,
@@ -226,6 +228,8 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
                 )
             wf.name = f.name
             wf.dtype_ipc = dtype_to_ipc(f.dtype)
+            if f.frame is not None:
+                frame_to_proto(wf.frame, f.frame)
     elif isinstance(plan, UnresolvedShuffleExec):
         n.unresolved_shuffle.stage_id = plan.stage_id
         n.unresolved_shuffle.schema_ipc = schema_to_ipc(plan.schema())
@@ -416,6 +420,7 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
                     order,
                     wf.name,
                     dtype_from_ipc(wf.dtype_ipc),
+                    frame_from_proto(wf.frame) if wf.HasField("frame") else None,
                 )
             )
         return WindowExec(input, funcs)

@@ -22,6 +22,7 @@ KEYWORDS = {
     "extract", "substring", "for", "create", "external", "table", "stored",
     "location", "with", "header", "row", "options", "explain", "analyze",
     "verbose", "escape", "over", "partition",
+    "rows", "range", "unbounded", "preceding", "following", "current",
 }
 
 

@@ -620,9 +620,45 @@ class Parser:
                 order_by.append(lx.SortExpr(e, asc, False))
                 if not self.eat_op(","):
                     break
+        frame = None
+        if self.eat_keyword("rows"):
+            frame = self._parse_rows_frame()
+        elif self.at_keyword("range"):
+            raise SqlError("RANGE window frames are not supported (use ROWS)")
         self.expect_op(")")
         arg = args[0] if args else None
-        return lx.WindowExpr(fname, arg, partition_by, order_by)
+        return lx.WindowExpr(fname, arg, partition_by, order_by, frame)
+
+    def _parse_rows_frame(self):
+        """ROWS BETWEEN <bound> AND <bound> | ROWS <bound>."""
+
+        def bound(is_start: bool):
+            if self.eat_keyword("unbounded"):
+                if self.eat_keyword("preceding"):
+                    return None if is_start else ("lo",)
+                self.expect_keyword("following")
+                return ("hi",) if is_start else None
+            if self.eat_keyword("current"):
+                self.expect_keyword("row")
+                return 0
+            k = self.parse_expr()
+            if not isinstance(k, lx.Literal) or not isinstance(k.value, int):
+                raise SqlError("ROWS frame offset must be an integer literal")
+            if self.eat_keyword("preceding"):
+                return -k.value
+            self.expect_keyword("following")
+            return k.value
+
+        if self.eat_keyword("between"):
+            start = bound(True)
+            self.expect_keyword("and")
+            end = bound(False)
+        else:
+            start = bound(True)
+            end = 0  # shorthand: ROWS <x> PRECEDING == .. AND CURRENT ROW
+        if start == ("hi",) or end == ("lo",):
+            raise SqlError("invalid window frame bounds")
+        return (start, end)
 
     def _parse_case(self) -> lx.Expr:
         self.expect_keyword("case")

@@ -164,6 +164,7 @@ def rewrite_expr(e: lx.Expr, mapping: Dict[str, lx.Expr]) -> lx.Expr:
             None if e.arg is None else rewrite_expr(e.arg, mapping),
             [rewrite_expr(p, mapping) for p in e.partition_by],
             [rewrite_expr(o, mapping) for o in e.order_by],
+            e.frame,
         )
     return e
 

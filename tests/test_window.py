@@ -119,3 +119,138 @@ def test_window_distributed(sales_table):
         ctx.close()
     finally:
         cluster.shutdown()
+
+
+def test_running_default_frame_with_order_by(ctx):
+    """Aggregate + ORDER BY and no frame clause = SQL's running default
+    (UNBOUNDED PRECEDING .. CURRENT ROW)."""
+    c, df = ctx
+    out = c.sql(
+        "select g, v, sum(v) over (partition by g order by v) as rs from t "
+        "order by g, v"
+    ).collect().to_pandas()
+    exp = (
+        df.sort_values(["g", "v"])
+        .groupby("g")["v"].cumsum()
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(out["rs"].to_numpy(), exp.to_numpy(), rtol=1e-9)
+
+
+def test_rows_between_moving_window(ctx):
+    c, df = ctx
+    out = c.sql(
+        "select g, v, "
+        "sum(v) over (partition by g order by v rows between 2 preceding and current row) as ms, "
+        "avg(v) over (partition by g order by v rows between 1 preceding and 1 following) as ma, "
+        "min(v) over (partition by g order by v rows between 2 preceding and current row) as mn, "
+        "max(v) over (partition by g order by v rows between 1 preceding and 1 following) as mx "
+        "from t order by g, v"
+    ).collect().to_pandas()
+    s = df.sort_values(["g", "v"]).reset_index(drop=True)
+    gb = s.groupby("g")["v"]
+    np.testing.assert_allclose(
+        out["ms"].to_numpy(),
+        gb.rolling(3, min_periods=1).sum().reset_index(drop=True).to_numpy(),
+        rtol=1e-9,
+    )
+    np.testing.assert_allclose(
+        out["ma"].to_numpy(),
+        gb.rolling(3, min_periods=1, center=True).mean().reset_index(drop=True).to_numpy(),
+        rtol=1e-9,
+    )
+    np.testing.assert_allclose(
+        out["mn"].to_numpy(),
+        gb.rolling(3, min_periods=1).min().reset_index(drop=True).to_numpy(),
+        rtol=1e-9,
+    )
+    np.testing.assert_allclose(
+        out["mx"].to_numpy(),
+        gb.rolling(3, min_periods=1, center=True).max().reset_index(drop=True).to_numpy(),
+        rtol=1e-9,
+    )
+
+
+def test_rows_unbounded_following(ctx):
+    """Suffix frame: CURRENT ROW .. UNBOUNDED FOLLOWING."""
+    c, df = ctx
+    out = c.sql(
+        "select g, v, sum(v) over (partition by g order by v "
+        "rows between current row and unbounded following) as tail from t "
+        "order by g, v"
+    ).collect().to_pandas()
+    s = df.sort_values(["g", "v"]).reset_index(drop=True)
+    exp = (
+        s.iloc[::-1].groupby("g")["v"].cumsum().iloc[::-1].reset_index(drop=True)
+    )
+    np.testing.assert_allclose(out["tail"].to_numpy(), exp.to_numpy(), rtol=1e-9)
+
+
+def test_frame_serde_roundtrip(ctx):
+    from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
+    from ballista_tpu.physical.window import WindowExec
+
+    c, _ = ctx
+    df = c.sql(
+        "select sum(v) over (order by v rows between 3 preceding and 1 following) as s from t"
+    )
+    phys = c.create_physical_plan(df.logical_plan())
+    back = phys_plan_from_proto(phys_plan_to_proto(phys))
+
+    def find(n):
+        if isinstance(n, WindowExec):
+            return n
+        for ch in n.children():
+            r = find(ch)
+            if r is not None:
+                return r
+        return None
+
+    w = find(back)
+    assert w is not None and w.funcs[0].frame == (-3, 1)
+
+
+def test_frame_errors(ctx):
+    c, _ = ctx
+    from ballista_tpu.errors import BallistaError
+
+    with pytest.raises(BallistaError):
+        c.sql("select sum(v) over (order by v range between 1 preceding and current row) as s from t")
+    with pytest.raises(BallistaError):
+        c.sql("select row_number() over (order by v rows between 1 preceding and current row) as s from t")
+
+
+def test_running_default_includes_peers():
+    """SQL's default frame with ORDER BY is RANGE-based: rows tied on the
+    order key are peers and all see the same running value."""
+    c = ExecutionContext()
+    t = pa.table({"k": pa.array([1, 1, 2]), "v": pa.array([10.0, 20.0, 5.0])})
+    c.register_record_batches("t2", t)
+    out = c.sql("select k, sum(v) over (order by k) as s from t2 order by k").collect()
+    assert out.column("s").to_pylist() == [30.0, 30.0, 35.0]
+
+
+def test_frame_survives_group_by_rewrite():
+    """Window frames inside a GROUP BY query must survive the planner's
+    expression rewrite (review regression: frame silently dropped)."""
+    c = ExecutionContext()
+    t = pa.table({"g": pa.array(["a"] * 4), "k": pa.array([1, 2, 3, 4])})
+    c.register_record_batches("t3", t)
+    out = c.sql(
+        "select g, k, sum(k) over (partition by g order by k "
+        "rows between 1 preceding and current row) as ms "
+        "from t3 group by g, k order by k"
+    ).collect()
+    assert out.column("ms").to_pylist() == [1, 3, 5, 7]
+
+
+def test_huge_frame_offsets_clamped():
+    """Giant ROWS offsets must cost O(partition), not O(offset)."""
+    c = ExecutionContext()
+    t = pa.table({"v": pa.array([3.0, 1.0, 2.0])})
+    c.register_record_batches("t4", t)
+    out = c.sql(
+        "select v, min(v) over (order by v rows between 1000000000 preceding "
+        "and current row) as m from t4 order by v"
+    ).collect()
+    assert out.column("m").to_pylist() == [1.0, 1.0, 1.0]
