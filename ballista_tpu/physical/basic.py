@@ -319,8 +319,14 @@ class EmptyExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         if self.produce_one_row:
-            arrays = [pa.nulls(1, type=f.type) for f in self._schema]
-            yield pa.RecordBatch.from_arrays(arrays, schema=self._schema)
+            schema = self._schema
+            if len(schema) == 0:
+                # a zero-column batch cannot carry a row count in Arrow;
+                # emit a placeholder null column so FROM-less SELECTs (pure
+                # projections over this one row) see num_rows == 1
+                schema = pa.schema([pa.field("__placeholder", pa.null())])
+            arrays = [pa.nulls(1, type=f.type) for f in schema]
+            yield pa.RecordBatch.from_arrays(arrays, schema=schema)
 
     def fmt(self) -> str:
         return f"EmptyExec: produce_one_row={self.produce_one_row}"

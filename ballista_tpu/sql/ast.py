@@ -52,6 +52,9 @@ class SelectStmt:
     limit: Optional[int] = None
     offset: int = 0
     union_with: List[Tuple["SelectStmt", bool]] = field(default_factory=list)  # (stmt, all)
+    # GROUP BY ROLLUP/CUBE/GROUPING SETS: index lists into group_by, one per
+    # grouping set; None = plain GROUP BY
+    grouping_sets: Optional[List[List[int]]] = None
 
 
 @dataclass

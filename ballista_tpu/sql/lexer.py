@@ -23,6 +23,7 @@ KEYWORDS = {
     "location", "with", "header", "row", "options", "explain", "analyze",
     "verbose", "escape", "over", "partition",
     "rows", "range", "unbounded", "preceding", "following", "current",
+    "rollup", "cube", "grouping", "sets",
 }
 
 

@@ -27,6 +27,15 @@ from ballista_tpu.sql.ast import (
 )
 from ballista_tpu.sql.lexer import Token, tokenize
 
+# keywords that stay legal as identifiers (clause-introducers only; the
+# primary-expression and identifier parsers fall back to treating them as
+# names). Frame/grouping words are positional: `rows`/`rollup` only act as
+# syntax right after ORDER BY exprs / GROUP BY.
+NON_RESERVED = {
+    "rollup", "cube", "grouping", "sets",
+    "rows", "range", "unbounded", "preceding", "following", "current",
+}
+
 _CMP_OPS = {"=": "eq", "<>": "neq", "!=": "neq", "<": "lt", "<=": "lteq",
             ">": "gt", ">=": "gteq"}
 
@@ -95,10 +104,19 @@ class Parser:
     def expect_ident(self) -> str:
         t = self.peek()
         # allow non-reserved keywords as identifiers where unambiguous
-        if t.kind in ("ident",):
+        if self._identish(t):
             self.next()
             return t.value
         raise SqlError(f"expected identifier, found {t.value!r} at {t.pos}")
+
+    @staticmethod
+    def _identish(t) -> bool:
+        """Identifiers plus non-reserved keywords (words the lexer tokenizes
+        as keywords for clause parsing but that remain legal column/table
+        names, e.g. a column literally named `cube`)."""
+        return t.kind == "ident" or (
+            t.kind == "keyword" and t.value in NON_RESERVED
+        )
 
     # -- entry -------------------------------------------------------------
     def parse_statement(self):
@@ -214,10 +232,13 @@ class Parser:
             stmt.where = self.parse_expr()
         if self.eat_keyword("group"):
             self.expect_keyword("by")
-            while True:
-                stmt.group_by.append(self.parse_expr())
-                if not self.eat_op(","):
-                    break
+            if self.at_keyword("rollup", "cube", "grouping"):
+                self._parse_grouping_sets(stmt)
+            else:
+                while True:
+                    stmt.group_by.append(self.parse_expr())
+                    if not self.eat_op(","):
+                        break
         if self.eat_keyword("having"):
             stmt.having = self.parse_expr()
         self._parse_order_limit(stmt)
@@ -553,13 +574,13 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
-        if t.kind == "ident":
+        if self._identish(t):
             name = self.expect_ident()
             # function call?
             if self.at_op("("):
                 return self._parse_function(name)
             # qualified column a.b
-            if self.at_op(".") and self.peek(1).kind == "ident":
+            if self.at_op(".") and self._identish(self.peek(1)):
                 self.next()
                 col2 = self.expect_ident()
                 return lx.Column(col2.lower(), name.lower())
@@ -628,6 +649,56 @@ class Parser:
         self.expect_op(")")
         arg = args[0] if args else None
         return lx.WindowExpr(fname, arg, partition_by, order_by, frame)
+
+    def _parse_grouping_sets(self, stmt) -> None:
+        """GROUP BY ROLLUP(a, b) | CUBE(a, b) | GROUPING SETS ((a, b), (a), ())
+        — lowered to explicit index sets over a shared key list."""
+
+        def key_index(e) -> int:
+            s = str(e)
+            for i, g in enumerate(stmt.group_by):
+                if str(g) == s:
+                    return i
+            stmt.group_by.append(e)
+            return len(stmt.group_by) - 1
+
+        if self.eat_keyword("rollup"):
+            self.expect_op("(")
+            idxs = [key_index(self.parse_expr())]
+            while self.eat_op(","):
+                idxs.append(key_index(self.parse_expr()))
+            self.expect_op(")")
+            stmt.grouping_sets = [idxs[:k] for k in range(len(idxs), -1, -1)]
+        elif self.eat_keyword("cube"):
+            self.expect_op("(")
+            idxs = [key_index(self.parse_expr())]
+            while self.eat_op(","):
+                idxs.append(key_index(self.parse_expr()))
+            self.expect_op(")")
+            if len(idxs) > 6:
+                raise SqlError("CUBE supports at most 6 keys (2^k grouping sets)")
+            sets = []
+            for mask in range(1 << len(idxs)):
+                sets.append([idxs[i] for i in range(len(idxs)) if mask & (1 << i)])
+            # conventional order: most-detailed first
+            stmt.grouping_sets = sorted(sets, key=len, reverse=True)
+        else:
+            self.expect_keyword("grouping")
+            self.expect_keyword("sets")
+            self.expect_op("(")
+            stmt.grouping_sets = []
+            while True:
+                self.expect_op("(")
+                one: list = []
+                if not self.eat_op(")"):
+                    one.append(key_index(self.parse_expr()))
+                    while self.eat_op(","):
+                        one.append(key_index(self.parse_expr()))
+                    self.expect_op(")")
+                stmt.grouping_sets.append(one)
+                if not self.eat_op(","):
+                    break
+            self.expect_op(")")
 
     def _parse_rows_frame(self):
         """ROWS BETWEEN <bound> AND <bound> | ROWS <bound>."""

@@ -100,6 +100,23 @@ def collect_windows(e: lx.Expr, out: List["lx.WindowExpr"]) -> None:
         collect_windows(c, out)
 
 
+def _null_out(e: lx.Expr, excluded_strs) -> lx.Expr:
+    """Replace references to excluded group keys with NULL (grouping-set
+    branches); NULL propagates through enclosing expressions. Aggregate
+    arguments are protected: super-aggregate rows aggregate the REAL column
+    (count(r) in the grand total counts every non-null r, per the standard),
+    only the group-key projection of r becomes NULL."""
+    if not excluded_strs:
+        return e
+    aggs: List[lx.AggregateExpr] = []
+    collect_aggregates(e, aggs)
+    hide = {str(a): lx.Column(f"__gs_protect_{i}") for i, a in enumerate(aggs)}
+    unhide = {str(c): a for a, c in zip(aggs, hide.values())}
+    e = rewrite_expr(e, hide)
+    e = rewrite_expr(e, {s: lx.Literal(None, pa.null()) for s in excluded_strs})
+    return rewrite_expr(e, unhide)
+
+
 def rewrite_expr(e: lx.Expr, mapping: Dict[str, lx.Expr]) -> lx.Expr:
     """Replace any subtree whose str() matches a mapping key."""
     key = str(e)
@@ -188,13 +205,19 @@ class SelectPlanner:
         self.outer_schema = outer_schema
 
     # -- entry -------------------------------------------------------------
+    def _plan_core(self, stmt: sa.SelectStmt) -> lp.LogicalPlan:
+        """One statement body, grouping sets included (no union/order)."""
+        if stmt.grouping_sets is not None:
+            return self._plan_grouping_sets(stmt)
+        return self._plan_body(stmt)
+
     def plan(self, stmt: sa.SelectStmt) -> lp.LogicalPlan:
-        plan = self._plan_body(stmt)
+        plan = self._plan_core(stmt)
         if stmt.union_with:
             branches = [plan]
             all_flags = []
             for sub, all_ in stmt.union_with:
-                branches.append(self._plan_body(sub))
+                branches.append(self._plan_core(sub))
                 all_flags.append(all_)
             # normalize field names to the first branch's
             base_schema = branches[0].schema()
@@ -220,6 +243,72 @@ class SelectPlanner:
             plan = u
         plan = self._apply_order_limit(plan, stmt)
         return plan
+
+    # -- grouping sets ------------------------------------------------------
+    def _plan_grouping_sets(self, stmt: sa.SelectStmt) -> lp.LogicalPlan:
+        """ROLLUP/CUBE/GROUPING SETS lower to a UNION ALL of one aggregation
+        per grouping set; group keys excluded from a set project as typed
+        NULLs (references to them inside expressions become NULL and
+        propagate). GROUPING() is not supported."""
+        import dataclasses
+
+        # probe: the full-key variant fixes the output schema (types for the
+        # NULL fills and the union contract)
+        probe = dataclasses.replace(
+            stmt, grouping_sets=None, order_by=[], limit=None, offset=0,
+            union_with=[],
+        )
+        probe_plan = self._plan_body(probe)
+        out_schema = probe_plan.schema()
+
+        if any(not isinstance(e, lx.Expr) for e, _ in stmt.projections):
+            raise SqlError("SELECT * is not valid with grouping sets")
+        if len(out_schema) != len(stmt.projections):
+            raise SqlError("grouping sets cannot resolve the select list")
+
+        branches: List[lp.LogicalPlan] = []
+        all_keys = set(range(len(stmt.group_by)))
+        for s in stmt.grouping_sets:
+            if set(s) == all_keys:
+                # the probe IS the full-key branch (ROLLUP/CUBE always have
+                # one); don't plan the most expensive branch twice
+                branches.append(probe_plan)
+                continue
+            excluded = {
+                str(stmt.group_by[i])
+                for i in range(len(stmt.group_by))
+                if i not in s
+            }
+            # cast + alias every entry to the probe's field so all branches
+            # share one schema (names AND types) for the union
+            projections = []
+            for (e, _alias), f_out in zip(stmt.projections, out_schema):
+                e2 = _null_out(e, excluded)
+                projections.append((lx.Alias(lx.Cast(e2, f_out.type), f_out.name), None))
+            having = (
+                _null_out(stmt.having, excluded)
+                if stmt.having is not None
+                else None
+            )
+            variant = dataclasses.replace(
+                stmt,
+                projections=projections,
+                group_by=[stmt.group_by[i] for i in s],
+                having=having,
+                grouping_sets=None,
+                order_by=[],
+                limit=None,
+                offset=0,
+                union_with=[],
+            )
+            branches.append(self._plan_body(variant))
+        # ORDER BY on the union resolves selected expressions to the shared
+        # output columns (per-branch aggregate mappings don't apply)
+        self._order_mapping = {
+            str(e): lx.Column(f_out.name)
+            for (e, _a), f_out in zip(stmt.projections, out_schema)
+        }
+        return lp.Union(branches, all=True)
 
     # -- body (no union/order/limit) ---------------------------------------
     def _plan_body(self, stmt: sa.SelectStmt) -> lp.LogicalPlan:

@@ -1,0 +1,143 @@
+"""ROLLUP / CUBE / GROUPING SETS (lowered to a UNION ALL of one aggregation
+per grouping set; excluded keys project as typed NULLs)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.errors import BallistaError, SqlError
+
+
+@pytest.fixture
+def ctx():
+    c = ExecutionContext()
+    rng = np.random.default_rng(7)
+    t = pa.table(
+        {
+            "r": pa.array(rng.choice(["east", "west", "north"], 200).tolist()),
+            "p": pa.array(rng.choice(["a", "b", "c", "d"], 200).tolist()),
+            "v": pa.array(np.round(rng.uniform(0, 100, 200), 2)),
+            "q": pa.array(rng.integers(1, 20, 200), type=pa.int64()),
+        }
+    )
+    c.register_record_batches("s", t)
+    return c, t.to_pandas()
+
+
+def _rollup_oracle(df, keys, agg_col="v"):
+    frames = []
+    for k in range(len(keys), -1, -1):
+        sub = keys[:k]
+        if sub:
+            g = df.groupby(sub, as_index=False).agg(s=(agg_col, "sum"), n=(agg_col, "count"))
+        else:
+            g = pd.DataFrame({"s": [df[agg_col].sum()], "n": [len(df)]})
+        for missing in keys[k:]:
+            g[missing] = None
+        frames.append(g[keys + ["s", "n"]])
+    return pd.concat(frames, ignore_index=True)
+
+
+def test_rollup_matches_pandas(ctx):
+    c, df = ctx
+    out = (
+        c.sql("select r, p, sum(v) as s, count(v) as n from s "
+              "group by rollup(r, p) order by r, p")
+        .collect().to_pandas()
+    )
+    exp = (
+        _rollup_oracle(df, ["r", "p"])
+        .sort_values(["r", "p"], na_position="last")
+        .reset_index(drop=True)
+    )
+    assert out["r"].fillna("~").tolist() == exp["r"].fillna("~").tolist()
+    assert out["p"].fillna("~").tolist() == exp["p"].fillna("~").tolist()
+    np.testing.assert_allclose(out["s"].to_numpy(), exp["s"].to_numpy(), rtol=1e-9)
+    assert out["n"].tolist() == exp["n"].tolist()
+
+
+def test_cube_counts(ctx):
+    c, df = ctx
+    out = c.sql("select r, p, sum(q) as s from s group by cube(r, p)").collect()
+    nr, np_ = df["r"].nunique(), df["p"].nunique()
+    pairs = df.groupby(["r", "p"]).ngroups
+    assert out.num_rows == pairs + nr + np_ + 1
+    # grand total row
+    tot = [s for r, p, s in zip(out.column("r").to_pylist(),
+                                out.column("p").to_pylist(),
+                                out.column("s").to_pylist())
+           if r is None and p is None]
+    assert tot == [df["q"].sum()]
+
+
+def test_grouping_sets_explicit(ctx):
+    c, df = ctx
+    out = (
+        c.sql("select r, p, sum(v) as s from s "
+              "group by grouping sets ((r, p), (p), ()) order by p, r")
+        .collect()
+    )
+    assert out.num_rows == df.groupby(["r", "p"]).ngroups + df["p"].nunique() + 1
+
+
+def test_rollup_with_having_and_exprs(ctx):
+    c, df = ctx
+    out = (
+        c.sql("select r, sum(v) as s from s group by rollup(r) "
+              "having sum(v) > 0 order by s desc limit 2")
+        .collect()
+    )
+    # grand total is the largest
+    np.testing.assert_allclose(out.column("s").to_pylist()[0], df["v"].sum(), rtol=1e-9)
+
+
+def test_rollup_rejects_star(ctx):
+    c, _ = ctx
+    with pytest.raises(BallistaError):
+        c.sql("select * from s group by rollup(r)")
+
+
+def test_super_aggregate_counts_real_column(ctx):
+    """count(r) in the grand-total row counts every non-null r — the NULL
+    substitution must not reach aggregate arguments (review regression)."""
+    c, df = ctx
+    out = c.sql("select r, count(r) as n from s group by rollup(r) order by r").collect()
+    assert out.column("n").to_pylist()[-1] == len(df)
+
+
+def test_rollup_composes_with_union(ctx):
+    c, df = ctx
+    n_groups = df["r"].nunique()
+    u1 = c.sql("select r, sum(v) as s from s group by rollup(r) "
+               "union all select 'X' as r, 99.0 as s").collect()
+    assert u1.num_rows == n_groups + 2
+    u2 = c.sql("select 'X' as r, 99.0 as s union all "
+               "select r, sum(v) as s from s group by rollup(r)").collect()
+    assert u2.num_rows == n_groups + 2
+
+
+def test_order_by_aggregate_expr_over_rollup(ctx):
+    c, df = ctx
+    out = c.sql("select r, sum(v) as s from s group by rollup(r) order by sum(v) desc").collect()
+    np.testing.assert_allclose(out.column("s").to_pylist()[0], df["v"].sum(), rtol=1e-9)
+
+
+def test_nonreserved_keywords_stay_identifiers(ctx):
+    """Columns named cube/sets/rows remain addressable (the lexer reserves
+    them only as clause introducers)."""
+    c, _ = ctx
+    t = pa.table({"cube": pa.array([2, 1]), "sets": pa.array([3, 4]),
+                  "rows": pa.array([5, 6])})
+    c.register_record_batches("kw", t)
+    out = c.sql("select cube, sets, rows from kw order by cube").collect()
+    assert out.column("cube").to_pylist() == [1, 2]
+    assert out.column("rows").to_pylist() == [6, 5]
+
+
+def test_fromless_select_produces_one_row(ctx):
+    c, _ = ctx
+    out = c.sql("select 1 as a, 'x' as b").collect()
+    assert out.num_rows == 1
+    assert out.column("a").to_pylist() == [1]
