@@ -34,6 +34,10 @@ BALLISTA_TPU_FUSE_VOLATILE = "ballista.tpu.fuse_volatile_sources"  # aggregate o
 # distributed planner: collapse Partial->hash shuffle->Final aggregations
 # into ONE mesh program (shard_map + psum over ICI, parallel/spmd_stage.py)
 BALLISTA_TPU_SPMD = "ballista.tpu.spmd_stages"
+# high-cardinality sorted aggregation kernel: "layout" (chunked-segment
+# tiles, default) | "pallas" (MXU one-hot matmul with RMW DMA windows,
+# sum/count/avg only — measured slower on v5e, kept selectable)
+BALLISTA_TPU_SORTED_KERNEL = "ballista.tpu.sorted_kernel"
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -51,6 +55,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_DEVICE_JOIN: "false",
     BALLISTA_TPU_FUSE_VOLATILE: "false",
     BALLISTA_TPU_SPMD: "false",
+    BALLISTA_TPU_SORTED_KERNEL: "layout",
 }
 
 
@@ -114,6 +119,12 @@ class BallistaConfig(Mapping[str, str]):
 
     def tpu_spmd(self) -> bool:
         return self._settings[BALLISTA_TPU_SPMD].lower() in ("1", "true", "yes")
+
+    def tpu_sorted_kernel(self) -> str:
+        k = self._settings[BALLISTA_TPU_SORTED_KERNEL].strip().lower()
+        if k not in ("layout", "pallas"):
+            raise ValueError(f"unknown sorted kernel {k!r} (layout|pallas)")
+        return k
 
     def mesh_shape(self) -> Dict[str, int]:
         """Parse "data:4,model:2" into {"data": 4, "model": 2}."""

@@ -90,6 +90,7 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     # and it is not part of the aggregate subtree's display.
     flags = (
         f"fv={ctx.config.tpu_fuse_volatile()},dc={ctx.config.device_cache()},"
+        f"sk={ctx.config.tpu_sorted_kernel()},"
         f"topk={getattr(exec_node, '_topk_pushdown', None)}"
     )
     key = exec_node.display_indent() + "|" + ",".join(parts) + "|" + flags

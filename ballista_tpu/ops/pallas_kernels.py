@@ -7,11 +7,13 @@ systolic array instead of the VPU scatter the XLA segment_sum lowering uses.
 One grid pass streams row blocks HBM -> VMEM, accumulating [G, A] partials
 in the output block that stays resident in VMEM across grid steps.
 
-Status: a provided, tested alternative kernel (real-chip correctness at
-parity with XLA's segment_sum lowering on v5e). The default fused-stage path
-(ops/stage.py) keeps the XLA lowering, which also covers min/max and the
-hierarchical-accuracy summation; wire-in is a future optimization for
-sum/count-only stages.
+Two kernels: grouped_aggregate (small-G, one-hot matmul with the output
+block resident in VMEM) and sorted_grouped_sum (cardinality-independent,
+RMW DMA windows over sorted dense ranks). The latter is wired into the
+fused stage behind ballista.tpu.sorted_kernel=pallas
+(stage.py::_run_pallas_sorted); the chunked-segment layout remains the
+default because it measures faster on v5e (see the status note on
+_build_sorted and dev/probe_sorted.py).
 """
 
 from __future__ import annotations
@@ -104,8 +106,10 @@ def _build_sorted(n_values_padded: int, block: int, interpret: bool):
     Status: measured ~107ms for 6M rows on v5e (MXU utilization is capped by
     the skinny value dimension, and the RMW DMA serializes the grid). The
     chunked-segment layout (ops/layout.py + stage._sorted_core) does the
-    same job in ~0.15ms of device time and is the production path;
-    dev/probe_sorted.py keeps this kernel honest as the MXU alternative.
+    same job in ~0.15ms of device time and is the default; this kernel is
+    selectable with ballista.tpu.sorted_kernel=pallas (sum/count/avg
+    stages, stage.py::_run_pallas_sorted) and dev/probe_sorted.py keeps the
+    perf comparison honest.
     """
     import jax
     import jax.numpy as jnp
@@ -151,9 +155,9 @@ def _build_sorted(n_values_padded: int, block: int, interpret: bool):
             in_specs=[
                 pl.BlockSpec((B,), lambda i, bases: (i,)),
                 pl.BlockSpec((AV, B), lambda i, bases: (0, i)),
-                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
             scratch_shapes=[
                 pltpu.VMEM((AV, W), jnp.float32),
                 pltpu.SemaphoreType.DMA,

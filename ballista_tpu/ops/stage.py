@@ -563,7 +563,9 @@ class FusedAggregateStage:
     def _prepare_partition_sorted(self, partition: int, ctx) -> dict:
         """High-cardinality path: whole-partition chunked-segment layout
         (ops/layout.py). Sorting/ranking/materialization is cache-time host
-        work; per-query device work is O(N) elementwise + axis reductions."""
+        work; per-query device work is O(N) elementwise + axis reductions.
+        Config ballista.tpu.sorted_kernel=pallas selects the MXU one-hot
+        matmul kernel instead (sum/count/avg only)."""
         import jax.numpy as jnp
 
         from ballista_tpu.ops.layout import SortedSegmentLayout
@@ -576,6 +578,17 @@ class FusedAggregateStage:
         codes, key_values, n_groups = self._group_codes(batch)
         if n_groups == 0:
             return {"kind": "empty"}
+        if (
+            ctx.config.tpu_sorted_kernel() == "pallas"
+            and all(a.fn in ("sum", "count", "avg") for a in self.aggs)
+            and not any(self.int_exact)
+            # fact stages (sorted_cover_max) consume [V, L1] tiles + rank
+            # metadata the pallas entry doesn't carry
+            and not getattr(self, "sorted_cover_max", False)
+            # counts accumulate in f32 inside the kernel: exact only below 2^24
+            and batch.num_rows <= (1 << 24)
+        ):
+            return self._prepare_pallas_sorted(batch, codes, key_values, n_groups)
         layout = SortedSegmentLayout(
             codes, n_groups, cover_max=getattr(self, "sorted_cover_max", False)
         )
@@ -594,6 +607,88 @@ class FusedAggregateStage:
             "key_values": key_values,
             "n_groups": n_groups,
         }
+
+    def _prepare_pallas_sorted(self, batch, codes, key_values, n_groups) -> dict:
+        """Flat sorted residency for the pallas MXU kernel
+        (ops/pallas_kernels.py::sorted_grouped_sum)."""
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.pallas_kernels import SORT_BLOCK
+
+        order = np.argsort(codes, kind="stable")
+        n = len(order)
+        pad = (-n) % SORT_BLOCK
+        codes_sorted = codes[order].astype(np.int32)
+        if pad:
+            codes_sorted = np.concatenate(
+                [codes_sorted, np.full(pad, codes_sorted[-1], np.int32)]
+            )
+        npcols = self._lower_columns(batch)
+        cols: Dict[int, object] = {}
+        for idx, npcol in npcols.items():
+            flat = npcol[order]
+            fill = False if flat.dtype == np.bool_ else 0
+            cols[idx] = jnp.asarray(pad_to(flat, n + pad, fill))
+        row_valid = np.zeros(n + pad, dtype=np.bool_)
+        row_valid[:n] = True
+        return {
+            "kind": "pallas_sorted",
+            "codes": jnp.asarray(codes_sorted),
+            "cols": cols,
+            "row_valid": jnp.asarray(row_valid),
+            "key_values": key_values,
+            "n_groups": n_groups,
+        }
+
+    def _pallas_masked_rows_step(self):
+        """Jitted once per stage (a per-call closure would retrace every
+        query)."""
+        if getattr(self, "_pallas_step", None) is not None:
+            return self._pallas_step
+        import jax
+        import jax.numpy as jnp
+
+        filter_fns = self.filter_fns
+        value_fns = self.value_fns
+
+        @jax.jit
+        def masked_rows(cols, aux, row_valid):
+            mask = row_valid
+            for f in filter_fns:
+                mask = jnp.logical_and(mask, f.fn(cols, aux))
+            maskf = mask.astype(jnp.float32)
+            rows = [maskf]
+            for vf in value_fns:
+                if vf is None:
+                    continue
+                v = jnp.broadcast_to(vf.fn(cols, aux), mask.shape)
+                rows.append(v.astype(jnp.float32) * maskf)
+            return jnp.stack(rows)
+
+        self._pallas_step = masked_rows
+        return masked_rows
+
+    def _run_pallas_sorted(self, ent: dict, aux) -> pa.Table:
+        from ballista_tpu.ops.pallas_kernels import sorted_grouped_sum
+
+        vals = self._pallas_masked_rows_step()(ent["cols"], aux, ent["row_valid"])
+        out = np.asarray(
+            sorted_grouped_sum(ent["codes"], vals, ent["n_groups"])
+        ).astype(np.float64)
+        counts = out[0]
+        outputs: List[np.ndarray] = []
+        vi = 1
+        for a in self.aggs:
+            if a.fn == "count":
+                outputs.append(counts)
+                continue
+            outputs.append(out[vi])
+            vi += 1
+            if a.fn == "avg":
+                outputs.append(counts)
+        return self._assemble_partial(
+            outputs, counts, ent["key_values"], ent["n_groups"]
+        )
 
     def run(self, partition: int, ctx) -> Optional[pa.Table]:
         import jax.numpy as jnp
@@ -619,6 +714,8 @@ class FusedAggregateStage:
             return self.partial_schema.empty_table()
         if prepared["kind"] == "sorted":
             return self._run_sorted(prepared, aux)
+        if prepared["kind"] == "pallas_sorted":
+            return self._run_pallas_sorted(prepared, aux)
 
         # dispatch all batches asynchronously, then materialize — device
         # compute and d2h of batch i overlap dispatch of batch i+1

@@ -116,6 +116,49 @@ def test_highcard_uses_sorted_layout(tmp_path):
     assert "sorted" in kinds
 
 
+def test_pallas_sorted_kernel_path(tmp_path):
+    """ballista.tpu.sorted_kernel=pallas routes high-cardinality
+    sum/count/avg through the MXU one-hot kernel and matches the host."""
+    from ballista_tpu.ops import kernels
+
+    table = _make_table(n=60_000, g=2000)
+    path = _write(tmp_path, table)
+    kernels._stage_cache.clear()
+    ctx = ExecutionContext(
+        BallistaConfig({"ballista.executor.backend": "tpu",
+                        "ballista.tpu.sorted_kernel": "pallas"})
+    )
+    ctx.register_parquet("t", path)
+    t = (
+        ctx.table("t")
+        .filter(col("f") > lit(0.4))
+        .aggregate([col("k")], [F.sum(col("v")).alias("s"),
+                                F.count(col("v")).alias("c"),
+                                F.avg(col("v")).alias("a")])
+        .sort(col("k").sort())
+        .collect()
+    )
+    hctx = ExecutionContext(BallistaConfig({"ballista.executor.backend": "host"}))
+    hctx.register_parquet("t", path)
+    h = (
+        hctx.table("t")
+        .filter(col("f") > lit(0.4))
+        .aggregate([col("k")], [F.sum(col("v")).alias("s"),
+                                F.count(col("v")).alias("c"),
+                                F.avg(col("v")).alias("a")])
+        .sort(col("k").sort())
+        .collect()
+    )
+    assert t.column("c").to_pylist() == h.column("c").to_pylist()
+    np.testing.assert_allclose(t.column("s").to_numpy(), h.column("s").to_numpy(),
+                               rtol=1e-4, atol=2e-3)
+    np.testing.assert_allclose(t.column("a").to_numpy(), h.column("a").to_numpy(),
+                               rtol=1e-4, atol=1e-4)
+    stages = [s for s in kernels._stage_cache.values() if s not in (False, None)]
+    kinds = {e.get("kind") for s in stages for e in s._device_cache.values()}
+    assert "pallas_sorted" in kinds
+
+
 def test_skewed_groups_multi_chunk_fold(tmp_path):
     """One giant group among many small ones exercises the chunk fold
     (owner reduceat) path, min/max included."""
