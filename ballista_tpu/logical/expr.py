@@ -703,13 +703,14 @@ WINDOW_FUNCTIONS = ("row_number", "rank", "dense_rank") + AGGREGATE_FUNCTIONS
 
 
 class WindowExpr(Expr):
-    """fn(...) OVER (PARTITION BY ... ORDER BY ... [ROWS frame]).
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [ROWS|RANGE frame]).
 
     frame: None = the SQL default (whole partition without ORDER BY;
-    UNBOUNDED PRECEDING..CURRENT ROW with it), else a (start, end) pair of
-    ROWS offsets relative to the current row — None = unbounded on that
-    side, negative = PRECEDING, 0 = CURRENT ROW, positive = FOLLOWING.
-    RANGE frames are not supported."""
+    RANGE UNBOUNDED PRECEDING..CURRENT ROW with it), else a
+    (mode, start, end) triple. mode is "rows" (offsets count rows) or
+    "range" (offsets are order-key value deltas; requires one numeric
+    order key). None = unbounded on that side, negative = PRECEDING,
+    0 = CURRENT ROW, positive = FOLLOWING."""
 
     def __init__(
         self,
@@ -717,17 +718,21 @@ class WindowExpr(Expr):
         arg: Optional["Expr"],
         partition_by: List["Expr"],
         order_by: List["SortExpr"],
-        frame: Optional[Tuple[Optional[int], Optional[int]]] = None,
+        frame: Optional[Tuple[str, Optional[float], Optional[float]]] = None,
     ) -> None:
         fn = fn.lower()
         if fn not in WINDOW_FUNCTIONS:
             raise PlanError(f"unknown window function {fn!r}")
         if frame is not None:
-            start, end = frame
+            mode, start, end = frame
+            if mode not in ("rows", "range"):
+                raise PlanError(f"unknown frame mode {mode!r}")
             if fn in ("row_number", "rank", "dense_rank"):
                 raise PlanError(f"{fn} does not accept a frame clause")
             if start is not None and end is not None and start > end:
                 raise PlanError("window frame start is after its end")
+            if mode == "range" and len(order_by) != 1:
+                raise PlanError("RANGE frames require exactly one ORDER BY key")
         self.fn = fn
         self.arg = arg
         self.partition_by = partition_by
@@ -767,12 +772,13 @@ class WindowExpr(Expr):
         if self.order_by:
             parts.append("ORDER BY " + ", ".join(str(e) for e in self.order_by))
         if self.frame is not None:
-            parts.append(f"ROWS BETWEEN {_bound(self.frame[0], True)} "
-                         f"AND {_bound(self.frame[1], False)}")
+            mode, start, end = self.frame
+            parts.append(f"{mode.upper()} BETWEEN {_bound(start, True)} "
+                         f"AND {_bound(end, False)}")
         return f"{self.fn.upper()}({arg}) OVER ({' '.join(parts)})"
 
 
-def _bound(b: Optional[int], is_start: bool) -> str:
+def _bound(b, is_start: bool) -> str:
     if b is None:
         return "UNBOUNDED PRECEDING" if is_start else "UNBOUNDED FOLLOWING"
     if b == 0:

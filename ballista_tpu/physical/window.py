@@ -39,7 +39,7 @@ class WindowFuncDesc:
         order_by: List[Tuple[PhysicalExpr, bool]],  # (expr, ascending)
         name: str,
         dtype: pa.DataType,
-        frame: Optional[Tuple[Optional[int], Optional[int]]] = None,
+        frame: Optional[Tuple[str, Optional[float], Optional[float]]] = None,
     ) -> None:
         self.fn = fn
         self.arg = arg
@@ -47,8 +47,8 @@ class WindowFuncDesc:
         self.order_by = order_by
         self.name = name
         self.dtype = dtype
-        # ROWS frame (start, end) offsets; None side = unbounded; the whole
-        # tuple None = SQL default (resolved at execution)
+        # (mode, start, end) frame; None side = unbounded; the whole value
+        # None = SQL default (resolved at execution)
         self.frame = frame
 
 
@@ -163,13 +163,16 @@ class WindowExec(ExecutionPlan):
         else:
             av = np.ones(n, dtype=np.float64)
             valid = np.ones(n, dtype=bool)
+        starts_idx = np.flatnonzero(new_part)
+        seg_ends = np.append(starts_idx[1:], n)
+        explicit = None  # per-row [lo, hi) bounds, when not a plain ROWS frame
+        running = False  # explicit bounds with lo == partition start
         frame = f.frame
-        peers_hi = None
         if frame is None:
             if f.order_by:
-                frame = (None, 0)
                 # RANGE default: rows tied on the order keys are peers and
                 # every peer sees the same (full peer-run) value
+                frame = ("rows", None, 0)
                 ocodes = np.zeros(n, dtype=np.int64)
                 for i in range(len(f.order_by)):
                     c = _codes(sort_cols[f"__o{i}"])[order]
@@ -178,11 +181,48 @@ class WindowExec(ExecutionPlan):
                 changed[1:] = (ocodes[1:] != ocodes[:-1]) | new_part[1:]
                 run_starts = np.flatnonzero(changed)
                 nxt = np.append(run_starts[1:], n)
-                peers_hi = nxt[np.cumsum(changed) - 1]
+                explicit = (part_start, nxt[np.cumsum(changed) - 1])
+                running = True
             else:
-                frame = (None, None)
+                frame = ("rows", None, None)
+        mode, fstart, fend = frame
+        if mode == "range" and explicit is None:
+            # bounds via value search on the (sorted) single order key;
+            # PRECEDING/FOLLOWING track the ordering direction
+            karr = sort_cols["__o0"]
+            if not (
+                pa.types.is_integer(karr.type)
+                or pa.types.is_floating(karr.type)
+                or pa.types.is_decimal(karr.type)
+            ):
+                raise PlanError(
+                    f"RANGE frames require a numeric ORDER BY key, got {karr.type}"
+                )
+            kv = karr.to_numpy(zero_copy_only=False).astype(np.float64)[order]
+            running = fstart is None
+            if np.isnan(kv).any():
+                raise PlanError("RANGE frames require non-null order keys")
+            asc = f.order_by[0][1]
+            sign = 1.0 if asc else -1.0
+            kvs = kv * sign  # ascending view of the ordering
+            lo = np.empty(n, dtype=np.int64)
+            hi = np.empty(n, dtype=np.int64)
+            for s0, e0 in zip(starts_idx, seg_ends):
+                seg = kvs[s0:e0]
+                cur = seg
+                lo[s0:e0] = (
+                    s0
+                    if fstart is None
+                    else s0 + np.searchsorted(seg, cur + fstart, side="left")
+                )
+                hi[s0:e0] = (
+                    e0
+                    if fend is None
+                    else s0 + np.searchsorted(seg, cur + fend, side="right")
+                )
+            explicit = (lo, hi)
         nparts = int(part_id[-1]) + 1
-        if frame == (None, None):
+        if (fstart, fend) == (None, None) and explicit is None:
             cnt = np.zeros(nparts)
             np.add.at(cnt, part_id, valid.astype(np.float64))
             if f.fn == "count":
@@ -206,7 +246,8 @@ class WindowExec(ExecutionPlan):
             empty = (cnt == 0)[part_id][inv]
             return pc.cast(pa.array(vals, mask=empty), f.dtype)
         vals, null_mask = _framed_aggregate(
-            f.fn, av, valid, part_start, part_id, new_part, frame, peers_hi
+            f.fn, av, valid, part_start, part_id, new_part,
+            (fstart, fend), explicit, running,
         )
         arr = pa.array(vals[inv], mask=null_mask[inv] if null_mask is not None else None)
         return pc.cast(arr, f.dtype)
@@ -225,15 +266,17 @@ def _framed_aggregate(
     part_id: np.ndarray,
     new_part: np.ndarray,
     frame,
-    peers_hi: Optional[np.ndarray] = None,
+    explicit: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    running: bool = False,
 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Framed aggregates over rows already sorted by (partition keys, order
     keys). Per row i the window is rows [i+start, i+end] clamped to its
-    partition — or, when peers_hi is given (the RANGE running default), rows
-    [partition start, peers_hi[i]). sum/count/avg vectorize via prefix sums
-    (windows never cross partition bounds, so one global prefix array
-    suffices); min/max run per partition with accumulate / padded sliding
-    windows. Returns (values, null mask for empty windows)."""
+    partition — or, when `explicit` carries per-row [lo, hi) bounds (the
+    peer-inclusive running default, RANGE frames), exactly those rows.
+    sum/count/avg vectorize via prefix sums (windows never cross partition
+    bounds, so one global prefix array suffices); min/max run per partition
+    with accumulate / padded sliding windows (ROWS) or a sparse table
+    (explicit bounds). Returns (values, null mask for empty windows)."""
     n = len(av)
     start, end = frame
     # per-row partition bounds [ps, pe)
@@ -242,8 +285,9 @@ def _framed_aggregate(
     ps = part_start
     pe = ends[part_id]
     idx = np.arange(n)
-    if peers_hi is not None:
-        lo, hi = ps, peers_hi
+    if explicit is not None:
+        lo, hi = explicit
+        hi = np.maximum(hi, lo)
     else:
         lo = ps if start is None else np.clip(idx + start, ps, pe)
         hi = pe if end is None else np.clip(idx + end + 1, ps, pe)
@@ -264,21 +308,56 @@ def _framed_aggregate(
         raise PlanError(f"unsupported framed window function {fn}")
     fill = np.inf if fn == "min" else -np.inf
     acc = np.minimum.accumulate if fn == "min" else np.maximum.accumulate
+    red = np.minimum if fn == "min" else np.maximum
     v = np.where(valid, av, fill)
     out = np.empty(n, dtype=np.float64)
     for s0, e0 in zip(starts_idx, ends):
         seg = v[s0:e0]
         m = len(seg)
-        iseg = np.arange(m)
-        if peers_hi is not None:
-            run = acc(seg)
-            out[s0:e0] = run[peers_hi[s0:e0] - 1 - s0]
+        if explicit is not None and running:
+            # lo pinned at the partition start: one prefix accumulate,
+            # indexed at each row's (exclusive) end — the common
+            # running-default shape
+            run = acc(seg) if m else seg
+            R = hi[s0:e0] - s0
+            res = np.where(R > 0, run[np.maximum(R - 1, 0)], fill)
+            out[s0:e0] = res
+            continue
+        if explicit is not None:
+            # arbitrary monotone [lo, hi) per row: O(1) range min/max via a
+            # sparse table (O(m log m) build)
+            L = lo[s0:e0] - s0
+            R = hi[s0:e0] - s0
+            w = R - L
+            table = [seg]
+            span = 1
+            while span * 2 <= m:
+                prev = table[-1]
+                table.append(red(prev[: m - span * 2 + 1], prev[span: m - span + 1]))
+                span *= 2
+            res = np.full(m, fill)
+            nonempty = w > 0
+            if nonempty.any():
+                k = np.zeros(m, dtype=np.int64)
+                k[nonempty] = np.floor(np.log2(w[nonempty])).astype(np.int64)
+                a = np.full(m, fill)
+                b = np.full(m, fill)
+                for kk in np.unique(k[nonempty]):
+                    sel = nonempty & (k == kk)
+                    t = table[kk]
+                    a[sel] = t[L[sel]]
+                    b[sel] = t[R[sel] - (1 << kk)]
+                res = np.where(nonempty, red(a, b), fill)
+            out[s0:e0] = res
             continue
         # clamp offsets to the segment so a huge frame bound costs O(m),
         # not O(bound)
+        iseg = np.arange(m)
         cs = None if start is None else max(start, -m)
         ce = None if end is None else min(end, m)
-        if cs is None:
+        if cs is None and ce is None:
+            out[s0:e0] = acc(seg)[-1] if m else fill
+        elif cs is None:
             run = acc(seg)
             out[s0:e0] = run[np.clip(iseg + ce, 0, m - 1)]
             if ce < 0:  # first rows have empty windows

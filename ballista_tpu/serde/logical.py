@@ -93,22 +93,32 @@ def scalar_from_proto(s: pb.ScalarValue):
 def frame_to_proto(msg: "pb.WindowFrameNode", frame) -> None:
     """One encode/decode pair for WindowFrameNode, shared by the logical and
     physical serde (the frame tuple semantics live in lx.WindowExpr)."""
-    start, end = frame
+    mode, start, end = frame
     msg.SetInParent()
+    msg.range_mode = mode == "range"
     if start is None:
         msg.start_unbounded = True
     else:
-        msg.start = start
+        msg.start_value = start
     if end is None:
         msg.end_unbounded = True
     else:
-        msg.end = end
+        msg.end_value = end
 
 
 def frame_from_proto(msg: "pb.WindowFrameNode"):
+    mode = "range" if msg.range_mode else "rows"
+
+    def bound(unbounded: bool, v: float):
+        if unbounded:
+            return None
+        # ROWS offsets are row counts: restore int (exact in double)
+        return v if mode == "range" else int(v)
+
     return (
-        None if msg.start_unbounded else msg.start,
-        None if msg.end_unbounded else msg.end,
+        mode,
+        bound(msg.start_unbounded, msg.start_value),
+        bound(msg.end_unbounded, msg.end_value),
     )
 
 

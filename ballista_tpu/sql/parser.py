@@ -643,9 +643,9 @@ class Parser:
                     break
         frame = None
         if self.eat_keyword("rows"):
-            frame = self._parse_rows_frame()
-        elif self.at_keyword("range"):
-            raise SqlError("RANGE window frames are not supported (use ROWS)")
+            frame = self._parse_frame("rows")
+        elif self.eat_keyword("range"):
+            frame = self._parse_frame("range")
         self.expect_op(")")
         arg = args[0] if args else None
         return lx.WindowExpr(fname, arg, partition_by, order_by, frame)
@@ -700,8 +700,10 @@ class Parser:
                     break
             self.expect_op(")")
 
-    def _parse_rows_frame(self):
-        """ROWS BETWEEN <bound> AND <bound> | ROWS <bound>."""
+    def _parse_frame(self, mode: str):
+        """ROWS|RANGE BETWEEN <bound> AND <bound> | ROWS|RANGE <bound>.
+        ROWS offsets are integer row counts; RANGE offsets are numeric
+        order-key value deltas."""
 
         def bound(is_start: bool):
             if self.eat_keyword("unbounded"):
@@ -713,8 +715,14 @@ class Parser:
                 self.expect_keyword("row")
                 return 0
             k = self.parse_expr()
-            if not isinstance(k, lx.Literal) or not isinstance(k.value, int):
-                raise SqlError("ROWS frame offset must be an integer literal")
+            ok = isinstance(k, lx.Literal) and (
+                isinstance(k.value, int)
+                if mode == "rows"
+                else isinstance(k.value, (int, float))
+            )
+            if not ok:
+                kind = "an integer" if mode == "rows" else "a numeric"
+                raise SqlError(f"{mode.upper()} frame offset must be {kind} literal")
             if self.eat_keyword("preceding"):
                 return -k.value
             self.expect_keyword("following")
@@ -726,10 +734,10 @@ class Parser:
             end = bound(False)
         else:
             start = bound(True)
-            end = 0  # shorthand: ROWS <x> PRECEDING == .. AND CURRENT ROW
+            end = 0  # shorthand: <x> PRECEDING == .. AND CURRENT ROW
         if start == ("hi",) or end == ("lo",):
             raise SqlError("invalid window frame bounds")
-        return (start, end)
+        return (mode, start, end)
 
     def _parse_case(self) -> lx.Expr:
         self.expect_keyword("case")

@@ -207,15 +207,13 @@ def test_frame_serde_roundtrip(ctx):
         return None
 
     w = find(back)
-    assert w is not None and w.funcs[0].frame == (-3, 1)
+    assert w is not None and w.funcs[0].frame == ("rows", -3, 1)
 
 
 def test_frame_errors(ctx):
     c, _ = ctx
     from ballista_tpu.errors import BallistaError
 
-    with pytest.raises(BallistaError):
-        c.sql("select sum(v) over (order by v range between 1 preceding and current row) as s from t")
     with pytest.raises(BallistaError):
         c.sql("select row_number() over (order by v rows between 1 preceding and current row) as s from t")
 
@@ -254,3 +252,48 @@ def test_huge_frame_offsets_clamped():
         "and current row) as m from t4 order by v"
     ).collect()
     assert out.column("m").to_pylist() == [1.0, 1.0, 1.0]
+
+
+def test_range_frame_numeric_offsets(ctx):
+    """RANGE frames window by order-key VALUE, peers included."""
+    c, df = ctx
+    out = c.sql(
+        "select g, k, sum(k) over (partition by g order by k "
+        "range between 2 preceding and current row) as rs, "
+        "min(k) over (partition by g order by k "
+        "range between 1 preceding and 1 following) as mn "
+        "from t order by g, k"
+    ).collect().to_pandas()
+    s = df.sort_values(["g", "k"]).reset_index(drop=True)
+
+    def oracle_rs(grp):
+        return [grp[(grp >= kk - 2) & (grp <= kk)].sum() for kk in grp]
+
+    def oracle_mn(grp):
+        return [grp[(grp >= kk - 1) & (grp <= kk + 1)].min() for kk in grp]
+
+    exp_rs = s.groupby("g")["k"].transform(lambda x: pd.Series(oracle_rs(x), index=x.index))
+    exp_mn = s.groupby("g")["k"].transform(lambda x: pd.Series(oracle_mn(x), index=x.index))
+    np.testing.assert_allclose(out["rs"].to_numpy(), exp_rs.to_numpy())
+    np.testing.assert_allclose(out["mn"].to_numpy(), exp_mn.to_numpy())
+
+
+def test_range_frame_desc_ordering(ctx):
+    """PRECEDING follows the ordering direction under DESC."""
+    c, df = ctx
+    out = c.sql(
+        "select k, sum(k) over (order by k desc range between 1 preceding "
+        "and current row) as rs from t order by k desc"
+    ).collect().to_pandas()
+    s = df.sort_values("k", ascending=False).reset_index(drop=True)
+    exp = [df["k"][(df["k"] <= kk + 1) & (df["k"] >= kk)].sum() for kk in s["k"]]
+    np.testing.assert_allclose(out["rs"].to_numpy(), np.array(exp))
+
+
+def test_range_frame_requires_one_order_key(ctx):
+    c, _ = ctx
+    from ballista_tpu.errors import BallistaError
+
+    with pytest.raises(BallistaError):
+        c.sql("select sum(v) over (order by g, k range between 1 preceding "
+              "and current row) as s from t")
