@@ -743,11 +743,55 @@ class SelectPlanner:
         ):
             node = conjunct if isinstance(conjunct, lx.Exists) else conjunct.expr
             negated = isinstance(conjunct, lx.Not) or node.negated
+            if not self._subquery_is_correlated(node.stmt, outer_schema):
+                # uncorrelated EXISTS gates every outer row on whether the
+                # subquery yields any row at all: cross-join a one-row
+                # count aggregate over LIMIT 1 (one row decides the truth),
+                # filter on it, project it back away
+                try:
+                    sub = SelectPlanner(self.ctx).plan(node.stmt)
+                except SchemaError:
+                    # correlation the WHERE-conjunct scan missed (e.g. via
+                    # the SELECT list): fall through to the correlated path
+                    sub = None
+                if sub is not None:
+                    alias = f"__exists_{id(node)}"
+                    ncol_name = "__exists_n"
+                    probe = lp.Aggregate(
+                        lp.Limit(sub, 1),
+                        [],
+                        [lx.Alias(
+                            lx.AggregateExpr("count", lx.Wildcard(), False),
+                            ncol_name,
+                        )],
+                    )
+                    probe = lp.SubqueryAlias(probe, alias)
+                    joined = lp.CrossJoin(plan, probe)
+                    ncol = lx.Column(ncol_name, alias)
+                    zero = lx.Literal(0, pa.int64())
+                    cond = lx.BinaryExpr(ncol, "eq" if negated else "gt", zero)
+                    filtered = lp.Filter(joined, cond)
+                    # alias kept columns back to their FLAT names so shared
+                    # bare names across join sides stay unambiguous
+                    keep = [
+                        lx.Alias(
+                            lx.Column(
+                                f.name.split(".")[-1],
+                                f.name.split(".")[0] if "." in f.name else None,
+                            ),
+                            f.name,
+                        )
+                        for f in outer_schema
+                    ]
+                    return lp.Projection(filtered, keep)
             inner_plan, corr_keys, residuals = self._plan_subquery(
                 node.stmt, outer_schema
             )
             if not corr_keys:
-                raise SqlError("uncorrelated EXISTS not supported")
+                raise SqlError(
+                    "EXISTS subquery correlation must appear as equality "
+                    "conjuncts in the subquery's WHERE clause"
+                )
             on = [(o, i) for o, i in corr_keys]
             jt = lp.JoinType.ANTI if negated else lp.JoinType.SEMI
             return lp.Join(plan, inner_plan, on, jt, conjoin(residuals))

@@ -138,3 +138,31 @@ def test_scalar_subquery_uncorrelated(ctx):
 def test_count_star_empty_group(ctx):
     out = ctx.sql("select count(*) as n from sales where amount > 1000").collect()
     assert out.column("n").to_pylist() == [0]
+
+
+def test_uncorrelated_exists():
+    import pyarrow as pa
+
+    from ballista_tpu.engine import ExecutionContext
+
+    c = ExecutionContext()
+    c.register_record_batches("a", pa.table({"x": pa.array([1, 2, 3])}))
+    c.register_record_batches("b", pa.table({"y": pa.array([10])}))
+    c.register_record_batches(
+        "empty_t", pa.table({"z": pa.array([], type=pa.int64())})
+    )
+    assert (
+        c.sql("select x from a where exists (select y from b) order by x")
+        .collect().column("x").to_pylist() == [1, 2, 3]
+    )
+    assert c.sql("select x from a where exists (select z from empty_t)").collect().num_rows == 0
+    assert (
+        c.sql("select x from a where not exists (select z from empty_t) order by x")
+        .collect().column("x").to_pylist() == [1, 2, 3]
+    )
+    assert c.sql("select x from a where not exists (select y from b)").collect().num_rows == 0
+    # with an inner predicate and combined with other conjuncts
+    assert (
+        c.sql("select x from a where x > 1 and exists (select y from b where y = 10) order by x")
+        .collect().column("x").to_pylist() == [2, 3]
+    )
