@@ -127,6 +127,22 @@ class ExprCompiler:
             return CompiledValue("bool", between_fn)
 
         if isinstance(e, px.InListExpr):
+            if e.value_exprs is not None:
+                # per-row membership: equality OR-chain on device
+                probe = self.compile(e.expr)
+                members = [self.compile(ve) for ve in e.value_exprs]
+                if probe.kind == "code" or any(m.kind == "code" for m in members):
+                    raise UnsupportedOnDevice("expression IN over strings")
+
+                def inlist_expr_fn(cols, aux, pf=probe.fn, ms=members, neg=e.negated):
+                    x = pf(cols, aux)
+                    r = None
+                    for m in ms:
+                        eq = x == m.fn(cols, aux)
+                        r = eq if r is None else jnp.logical_or(r, eq)
+                    return jnp.logical_not(r) if neg else r
+
+                return CompiledValue("bool", inlist_expr_fn)
             v = self.compile(e.expr)
             if v.kind == "code":
                 d = v.dictionary

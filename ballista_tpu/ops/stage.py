@@ -111,7 +111,14 @@ def substitute_columns(e: px.PhysicalExpr, mapping: List[px.PhysicalExpr]) -> px
     if isinstance(e, px.CastExpr):
         return px.CastExpr(substitute_columns(e.expr, mapping), e.dtype, e.safe)
     if isinstance(e, px.InListExpr):
-        return px.InListExpr(substitute_columns(e.expr, mapping), e.values, e.negated)
+        return px.InListExpr(
+            substitute_columns(e.expr, mapping),
+            e.values,
+            e.negated,
+            None
+            if e.value_exprs is None
+            else [substitute_columns(v, mapping) for v in e.value_exprs],
+        )
     if isinstance(e, px.BetweenExpr):
         return px.BetweenExpr(
             substitute_columns(e.expr, mapping),

@@ -222,24 +222,47 @@ class CastExpr(PhysicalExpr):
 
 
 class InListExpr(PhysicalExpr):
-    def __init__(self, expr: PhysicalExpr, values: List[Any], negated: bool) -> None:
+    """expr [NOT] IN (members). Literal members use one hashed pc.is_in;
+    expression members evaluate the probe ONCE and fold equality with
+    Kleene OR. Both follow SQL three-valued logic: a NULL probe (or, for
+    the expression form, NULL members that prevent a definite answer)
+    yields NULL, so NOT IN never resurrects NULL rows."""
+
+    def __init__(
+        self,
+        expr: PhysicalExpr,
+        values: List[Any],
+        negated: bool,
+        value_exprs: Optional[List[PhysicalExpr]] = None,
+    ) -> None:
         self.expr = expr
-        self.values = values
+        self.values = values  # literals (ignored when value_exprs is set)
         self.negated = negated
+        self.value_exprs = value_exprs
 
     def children(self) -> List[PhysicalExpr]:
-        return [self.expr]
+        return [self.expr] + list(self.value_exprs or [])
 
     def evaluate(self, batch: pa.RecordBatch) -> pa.Array:
         v = _as_array(self.expr.evaluate(batch), batch.num_rows)
-        result = pc.is_in(v, value_set=pa.array(self.values))
-        return pc.invert(result) if self.negated else result
+        if self.value_exprs is None:
+            member = pc.is_in(v, value_set=pa.array(self.values))
+            # is_in returns FALSE for a null probe; SQL says NULL
+            member = pc.if_else(pc.is_valid(v), member, pa.scalar(None, pa.bool_()))
+        else:
+            member = None
+            for ve in self.value_exprs:
+                m = _as_array(ve.evaluate(batch), batch.num_rows)
+                eq = pc.equal(v, m)
+                member = eq if member is None else pc.or_kleene(member, eq)
+        return pc.invert(member) if self.negated else member
 
     def data_type(self, schema: pa.Schema) -> pa.DataType:
         return pa.bool_()
 
     def __str__(self) -> str:
-        return f"{self.expr} {'NOT ' if self.negated else ''}IN {self.values}"
+        members = self.value_exprs if self.value_exprs is not None else self.values
+        return f"{self.expr} {'NOT ' if self.negated else ''}IN {members}"
 
 
 class BetweenExpr(PhysicalExpr):
@@ -482,12 +505,19 @@ def create_physical_expr(e: lx.Expr, input_schema: pa.Schema) -> PhysicalExpr:
             e.negated,
         )
     if isinstance(e, lx.InList):
-        values = []
-        for v in e.values:
-            if not isinstance(v, lx.Literal):
-                raise PlanError("IN list values must be literals")
-            values.append(v.value)
-        return InListExpr(create_physical_expr(e.expr, input_schema), values, e.negated)
+        if all(isinstance(v, lx.Literal) for v in e.values):
+            values = [v.value for v in e.values]
+            return InListExpr(
+                create_physical_expr(e.expr, input_schema), values, e.negated
+            )
+        # non-literal members evaluate per row inside InListExpr (the probe
+        # is computed once, not once per member)
+        return InListExpr(
+            create_physical_expr(e.expr, input_schema),
+            [],
+            e.negated,
+            [create_physical_expr(v, input_schema) for v in e.values],
+        )
     if isinstance(e, lx.Like):
         base = BinaryPhysicalExpr(
             create_physical_expr(e.expr, input_schema),

@@ -86,9 +86,12 @@ def uncompile_expr(e: px.PhysicalExpr) -> lx.Expr:
             e.negated,
         )
     if isinstance(e, px.InListExpr):
-        return lx.InList(
-            uncompile_expr(e.expr), [lx.Literal(v) for v in e.values], e.negated
+        members = (
+            [uncompile_expr(v) for v in e.value_exprs]
+            if e.value_exprs is not None
+            else [lx.Literal(v) for v in e.values]
         )
+        return lx.InList(uncompile_expr(e.expr), members, e.negated)
     if isinstance(e, px.CaseExpr):
         return lx.Case(
             None if e.base is None else uncompile_expr(e.base),

@@ -166,3 +166,44 @@ def test_uncorrelated_exists():
         c.sql("select x from a where x > 1 and exists (select y from b where y = 10) order by x")
         .collect().column("x").to_pylist() == [2, 3]
     )
+
+
+def test_in_list_with_expressions():
+    c = ExecutionContext()
+    c.register_record_batches(
+        "t", pa.table({"x": pa.array([1, 2, 3]), "y": pa.array([2, 9, 9])})
+    )
+    # row-wise membership: (x,y) rows are (1,2),(2,9),(3,9)
+    assert (
+        c.sql("select x from t where x in (y, 3) order by x")
+        .collect().column("x").to_pylist() == [3]
+    )
+    assert (
+        c.sql("select x from t where x not in (y, 3) order by x")
+        .collect().column("x").to_pylist() == [1, 2]
+    )
+    assert (
+        c.sql("select x from t where x in (y + 1, 1) order by x")
+        .collect().column("x").to_pylist() == [1]
+    )
+
+
+def test_not_in_null_probe_three_valued():
+    """NULL probes yield NULL under IN and NOT IN for BOTH the literal and
+    expression member forms (review regression: literal NOT IN kept NULLs)."""
+    c = ExecutionContext()
+    c.register_record_batches(
+        "t", pa.table({"x": pa.array([1, None, 5]), "y": pa.array([8, 8, 8])})
+    )
+    assert (
+        c.sql("select x from t where x not in (1, 2) order by x")
+        .collect().column("x").to_pylist() == [5]
+    )
+    assert (
+        c.sql("select x from t where x not in (1, y - 6) order by x")
+        .collect().column("x").to_pylist() == [5]
+    )
+    assert (
+        c.sql("select x from t where x in (5, y - 7) order by x")
+        .collect().column("x").to_pylist() == [1, 5]
+    )
