@@ -595,6 +595,9 @@ SCALAR_FUNCTIONS = {
     "abs": "same",
     "signum": "same",
     "octet_length": "int",
+    # super-aggregate marker; resolved to 0/1 literals by the grouping-sets
+    # planner (only valid with ROLLUP/CUBE/GROUPING SETS)
+    "grouping": "int",
     "concat": "string",
     "lower": "string",
     "upper": "string",

@@ -246,9 +246,21 @@ class InListExpr(PhysicalExpr):
     def evaluate(self, batch: pa.RecordBatch) -> pa.Array:
         v = _as_array(self.expr.evaluate(batch), batch.num_rows)
         if self.value_exprs is None:
-            member = pc.is_in(v, value_set=pa.array(self.values))
-            # is_in returns FALSE for a null probe; SQL says NULL
-            member = pc.if_else(pc.is_valid(v), member, pa.scalar(None, pa.bool_()))
+            non_null = [x for x in self.values if x is not None]
+            if not non_null:
+                # IN (NULL, ...): never definitely true or false
+                member = pa.nulls(len(v), pa.bool_())
+            else:
+                member = pc.is_in(v, value_set=pa.array(non_null))
+                if len(non_null) < len(self.values):
+                    # a NULL member makes non-matches indefinite (NULL)
+                    member = pc.if_else(
+                        member, member, pa.scalar(None, pa.bool_())
+                    )
+                # is_in returns FALSE for a null probe; SQL says NULL
+                member = pc.if_else(
+                    pc.is_valid(v), member, pa.scalar(None, pa.bool_())
+                )
         else:
             member = None
             for ve in self.value_exprs:

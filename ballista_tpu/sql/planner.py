@@ -100,6 +100,32 @@ def collect_windows(e: lx.Expr, out: List["lx.WindowExpr"]) -> None:
         collect_windows(c, out)
 
 
+def _contains_grouping(e: lx.Expr) -> bool:
+    if isinstance(e, lx.ScalarFunction) and e.fn == "grouping":
+        return True
+    return any(
+        isinstance(c, lx.Expr) and _contains_grouping(c) for c in e.children()
+    )
+
+
+def dataclasses_replace_projections(stmt, mapping):
+    """stmt copy with the mapping applied to projections, having, order by."""
+    import dataclasses
+
+    return dataclasses.replace(
+        stmt,
+        projections=[
+            (rewrite_expr(e, mapping) if isinstance(e, lx.Expr) else e, a)
+            for e, a in stmt.projections
+        ],
+        having=None if stmt.having is None else rewrite_expr(stmt.having, mapping),
+        order_by=[
+            dataclasses.replace(oi, expr=rewrite_expr(oi.expr, mapping))
+            for oi in stmt.order_by
+        ],
+    )
+
+
 def _null_out(e: lx.Expr, excluded_strs) -> lx.Expr:
     """Replace references to excluded group keys with NULL (grouping-set
     branches); NULL propagates through enclosing expressions. Aggregate
@@ -249,13 +275,35 @@ class SelectPlanner:
         """ROLLUP/CUBE/GROUPING SETS lower to a UNION ALL of one aggregation
         per grouping set; group keys excluded from a set project as typed
         NULLs (references to them inside expressions become NULL and
-        propagate). GROUPING() is not supported."""
+        propagate), and GROUPING(key) markers resolve to 0/1 per set."""
         import dataclasses
+
+        def resolve_grouping_markers(e: lx.Expr, excluded_strs) -> lx.Expr:
+            """GROUPING(key) -> 1 when the key is aggregated away in this
+            grouping set, else 0 (the standard's super-aggregate marker)."""
+            mapping = {}
+            for g in stmt.group_by:
+                marker = lx.ScalarFunction("grouping", [g])
+                mapping[str(marker)] = lx.Literal(
+                    1 if str(g) in excluded_strs else 0, pa.int64()
+                )
+            return rewrite_expr(e, mapping)
 
         # probe: the full-key variant fixes the output schema (types for the
         # NULL fills and the union contract)
         probe = dataclasses.replace(
-            stmt, grouping_sets=None, order_by=[], limit=None, offset=0,
+            stmt,
+            projections=[
+                (resolve_grouping_markers(e, set()) if isinstance(e, lx.Expr) else e,
+                 a)
+                for e, a in stmt.projections
+            ],
+            having=(
+                resolve_grouping_markers(stmt.having, set())
+                if stmt.having is not None
+                else None
+            ),
+            grouping_sets=None, order_by=[], limit=None, offset=0,
             union_with=[],
         )
         probe_plan = self._plan_body(probe)
@@ -283,10 +331,10 @@ class SelectPlanner:
             # share one schema (names AND types) for the union
             projections = []
             for (e, _alias), f_out in zip(stmt.projections, out_schema):
-                e2 = _null_out(e, excluded)
+                e2 = _null_out(resolve_grouping_markers(e, excluded), excluded)
                 projections.append((lx.Alias(lx.Cast(e2, f_out.type), f_out.name), None))
             having = (
-                _null_out(stmt.having, excluded)
+                _null_out(resolve_grouping_markers(stmt.having, excluded), excluded)
                 if stmt.having is not None
                 else None
             )
@@ -312,6 +360,28 @@ class SelectPlanner:
 
     # -- body (no union/order/limit) ---------------------------------------
     def _plan_body(self, stmt: sa.SelectStmt) -> lp.LogicalPlan:
+        # GROUPING(key) under plain GROUP BY is constantly 0; anything the
+        # grouping-sets rewrite didn't resolve (non-key argument, no GROUP
+        # BY) must fail here with a clear message rather than at execution
+        if any(
+            _contains_grouping(e)
+            for e, _ in stmt.projections
+            if isinstance(e, lx.Expr)
+        ) or (stmt.having is not None and _contains_grouping(stmt.having)):
+            zeros = {
+                str(lx.ScalarFunction("grouping", [g])): lx.Literal(0, pa.int64())
+                for g in stmt.group_by
+            }
+            stmt = dataclasses_replace_projections(stmt, zeros)
+            for e, _ in stmt.projections:
+                if isinstance(e, lx.Expr) and _contains_grouping(e):
+                    raise SqlError(
+                        "GROUPING() takes a grouping key and requires GROUP BY"
+                    )
+            if stmt.having is not None and _contains_grouping(stmt.having):
+                raise SqlError(
+                    "GROUPING() takes a grouping key and requires GROUP BY"
+                )
         # 1. FROM + WHERE with join-graph ordering
         plan = self._plan_from_where(stmt)
 

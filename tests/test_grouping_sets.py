@@ -141,3 +141,34 @@ def test_fromless_select_produces_one_row(ctx):
     out = c.sql("select 1 as a, 'x' as b").collect()
     assert out.num_rows == 1
     assert out.column("a").to_pylist() == [1]
+
+
+def test_grouping_marker_function(ctx):
+    """GROUPING(key) = 1 on rows where the key is aggregated away."""
+    c, df = ctx
+    out = (
+        c.sql("select r, grouping(r) as gr, sum(v) as s from s "
+              "group by rollup(r) order by gr, r")
+        .collect()
+    )
+    n = df["r"].nunique()
+    assert out.column("gr").to_pylist() == [0] * n + [1]
+    assert out.column("r").to_pylist()[-1] is None
+    # usable in HAVING to drop super-aggregate rows
+    out2 = c.sql(
+        "select r, sum(v) as s from s group by rollup(r) "
+        "having grouping(r) = 0 order by r"
+    ).collect()
+    assert out2.num_rows == n and None not in out2.column("r").to_pylist()
+
+
+def test_grouping_marker_plain_group_by_and_errors(ctx):
+    c, df = ctx
+    out = c.sql("select r, grouping(r) as g from s group by r order by r").collect()
+    assert set(out.column("g").to_pylist()) == {0}
+    from ballista_tpu.errors import BallistaError
+
+    with pytest.raises(BallistaError):
+        c.sql("select grouping(v) as g from s group by r")
+    with pytest.raises(BallistaError):
+        c.sql("select grouping(r) as g from s")

@@ -207,3 +207,14 @@ def test_not_in_null_probe_three_valued():
         c.sql("select x from t where x in (5, y - 7) order by x")
         .collect().column("x").to_pylist() == [1, 5]
     )
+
+
+def test_in_list_null_member_three_valued():
+    c = ExecutionContext()
+    c.register_record_batches("t", pa.table({"x": pa.array([1, None, 5])}))
+    # a NULL member makes NOT IN indefinite for every non-matching row
+    assert c.sql("select x from t where x not in (1, null)").collect().num_rows == 0
+    assert (
+        c.sql("select x from t where x in (5, null)")
+        .collect().column("x").to_pylist() == [5]
+    )
