@@ -1,14 +1,20 @@
-"""Benchmark: TPC-H q1 end-to-end through the engine, TPU backend vs host
-Arrow backend on the same machine.
+"""Benchmark: TPC-H through the engine, TPU backend vs host Arrow backend
+on the same machine.
 
 Prints ONE JSON line:
   {"metric": ..., "value": rows/s on the device backend,
-   "unit": "rows/s/chip", "vs_baseline": speedup over the host backend}
+   "unit": "rows/s/chip", "vs_baseline": speedup over the host backend,
+   "configs": [per-query rows for q1/q3/q6/q10 at SF=1 and q1/q3/q6 at
+               SF=10 — each {"name", "sf", "tpu_ms", "cpu_ms", "speedup"}]}
 
 Reference baseline context: the reference publishes no numbers
 (BASELINE.md); the denominator here is this repo's own host Arrow path —
 the same role the reference's Rust CPU executor plays in BASELINE.json's
 target ("N x the CPU executor's rows/sec").
+
+The headline metric matches `rust/benchmarks/tpch/src/main.rs:117-183`
+(timed iterations against a persistent context); per-config rows cover
+BASELINE.md configs 1-4.
 """
 
 from __future__ import annotations
@@ -23,31 +29,49 @@ REPO = pathlib.Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
 SF = float(os.environ.get("BENCH_SF", "1"))
-DATA = REPO / ".bench_cache" / f"tpch_sf{SF}"
 QUERIES_DIR = REPO / "benchmarks" / "tpch" / "queries"
-QUERY = (QUERIES_DIR / "q1.sql").read_text()
 BATCH = "16777216"
-# secondary configs reported to stderr (BASELINE.md configs 1, 3 and the
-# high-cardinality aggregate-over-join shape)
-SIDE_QUERIES = ["q6", "q3", "q10"]
+# per-config rows reported in the JSON (BASELINE.md configs 1-3 + the
+# high-cardinality aggregate-over-join shape); SF=10 covers config 2's
+# "beyond SF=1" requirement with the cached oracle-verified dataset.
+CONFIGS = [(1.0, "q1"), (1.0, "q6"), (1.0, "q3"), (1.0, "q10"),
+           (10.0, "q1"), (10.0, "q6"), (10.0, "q3")]
+if os.environ.get("BENCH_CONFIGS"):  # e.g. "1.0:q1,10.0:q3"; "" keeps default
+    CONFIGS = []
+    for entry in os.environ["BENCH_CONFIGS"].split(","):
+        if not entry.strip():
+            continue
+        sf_s, sep, q = entry.partition(":")
+        if not sep or not q:
+            raise SystemExit(f"BENCH_CONFIGS entry {entry!r}: expected 'sf:query'")
+        CONFIGS.append((float(sf_s), q.strip()))
+# soft deadline: stop adding per-config rows once elapsed wall time passes
+# this, so the final JSON line always prints even on a degraded relay
+MAX_SECONDS = float(os.environ.get("BENCH_MAX_SECONDS", "2400"))
+_T_START = time.monotonic()
 
 
-def ensure_data() -> None:
-    if (DATA / "lineitem").exists():
+def data_dir(sf: float) -> pathlib.Path:
+    return REPO / ".bench_cache" / f"tpch_sf{sf}"
+
+
+def ensure_data(sf: float) -> None:
+    if (data_dir(sf) / "lineitem").exists():
         return
     from benchmarks.tpch.datagen import generate
 
-    DATA.parent.mkdir(exist_ok=True)
-    generate(str(DATA), sf=SF, parts=1)
+    data_dir(sf).parent.mkdir(exist_ok=True)
+    generate(str(data_dir(sf)), sf=sf, parts=1)
 
 
 _CTX = {}
 
 
-def _context(backend: str):
-    """One session per backend (TPC-style steady state: the context —
-    catalog, caches, compiled artifacts — persists across queries)."""
-    if backend not in _CTX:
+def _context(backend: str, sf: float):
+    """One session per (backend, SF) — TPC-style steady state: the context
+    (catalog, caches, compiled artifacts) persists across queries."""
+    key = (backend, sf)
+    if key not in _CTX:
         from ballista_tpu.config import BallistaConfig
         from ballista_tpu.engine import ExecutionContext
         from benchmarks.tpch.datagen import register_all
@@ -60,13 +84,13 @@ def _context(backend: str):
                 }
             )
         )
-        register_all(ctx, str(DATA))
-        _CTX[backend] = ctx
-    return _CTX[backend]
+        register_all(ctx, str(data_dir(sf)))
+        _CTX[key] = ctx
+    return _CTX[key]
 
 
-def run_once(backend: str, sql: str = QUERY) -> float:
-    ctx = _context(backend)
+def run_once(backend: str, sql: str, sf: float = SF) -> float:
+    ctx = _context(backend, sf)
     t0 = time.perf_counter()
     out = ctx.sql(sql).collect()
     dt = time.perf_counter() - t0
@@ -74,9 +98,8 @@ def run_once(backend: str, sql: str = QUERY) -> float:
     return dt
 
 
-def _probe_device(timeout_s: int = 180) -> None:
-    """Fail fast (exit 3) when the TPU relay is unreachable: jax.devices()
-    otherwise blocks forever and the whole bench run hangs silently."""
+def _probe_device_once(timeout_s: int) -> str | None:
+    """Returns None when the device backend answered, else the error tail."""
     import subprocess
 
     code = "import jax; print(jax.devices())"
@@ -85,79 +108,140 @@ def _probe_device(timeout_s: int = 180) -> None:
             [sys.executable, "-c", code], timeout=timeout_s, check=True,
             capture_output=True,
         )
+        return None
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
         tail = (e.stderr or b"").decode(errors="replace").strip().splitlines()[-3:]
-        print(
-            f"device backend unreachable ({e}); no benchmark possible\n"
-            + "\n".join(tail),
-            file=sys.stderr,
-        )
-        raise SystemExit(3)
+        return f"{e}\n" + "\n".join(tail)
+
+
+def _probe_device() -> None:
+    """Wait for the TPU relay within a bounded budget before giving up.
+
+    A transient relay outage at capture time must not void a round's
+    evidence: retry the probe for BENCH_PROBE_BUDGET seconds (default 600)
+    before exiting 3.  jax.devices() otherwise blocks forever and the whole
+    bench run hangs silently.
+    """
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "600"))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        remaining = deadline - time.monotonic()
+        err = _probe_device_once(timeout_s=int(min(120, max(30, remaining))))
+        if err is None:
+            if attempt > 1:
+                print(f"device probe succeeded on attempt {attempt}",
+                      file=sys.stderr)
+            return
+        if time.monotonic() >= deadline:
+            print(
+                f"device backend unreachable after {attempt} probes over "
+                f"{budget:.0f}s ({err}); no benchmark possible",
+                file=sys.stderr,
+            )
+            raise SystemExit(3)
+        print(f"device probe {attempt} failed; retrying "
+              f"({remaining:.0f}s left in budget)", file=sys.stderr)
+        time.sleep(min(30, max(5, remaining / 10)))
+
+
+def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
+    try:
+        sql = (QUERIES_DIR / f"{name}.sql").read_text()
+        ensure_data(sf)
+        run_once("tpu", sql, sf)  # warmup: compile + caches
+        t = min(run_once("tpu", sql, sf) for _ in range(iters))
+        run_once("cpu", sql, sf)
+        c = min(run_once("cpu", sql, sf) for _ in range(iters))
+    except Exception as e:
+        print(f"[config] {name} sf={sf}: failed: {e}", file=sys.stderr)
+        return None
+    row = {
+        "name": name,
+        "sf": sf,
+        "tpu_ms": round(t * 1000, 1),
+        "cpu_ms": round(c * 1000, 1),
+        "speedup": round(c / t, 2),
+    }
+    print(f"[config] {name} sf={sf}: tpu={row['tpu_ms']}ms "
+          f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x", file=sys.stderr)
+    return row
+
+
+def _taxi_rows() -> list[dict]:
+    """NYC-taxi-shaped aggregation (BASELINE.md config 4), both zone
+    cardinalities."""
+    out = []
+    try:
+        from benchmarks.taxi.datagen import TRIP_AGG_QUERY, generate as taxi_gen
+    except Exception as e:
+        print(f"[config] taxi: unavailable: {e}", file=sys.stderr)
+        return out
+    for label, subdir, zones in (
+        ("taxi_10M_265groups", "taxi_sf1", None),
+        ("taxi_10M_10kgroups", "taxi_hc_sf1", 10_000),
+    ):
+        try:
+            ensure_data(1.0)  # _context(_, 1.0) registers the SF=1 catalog
+            d = REPO / ".bench_cache" / subdir
+            if not (d / "trips").exists():
+                kw = {"n_zones": zones} if zones else {}
+                taxi_gen(str(d), sf=1.0, parts=1, **kw)
+            table = "trips" if zones is None else "trips_hc"
+            sql = TRIP_AGG_QUERY.replace("from trips", f"from {table}")
+            for backend in ("tpu", "cpu"):
+                ctx = _context(backend, 1.0)
+                if table not in ctx.tables:
+                    ctx.register_parquet(table, str(d / "trips"))
+            run_once("tpu", sql, 1.0)
+            t = min(run_once("tpu", sql, 1.0) for _ in range(2))
+            run_once("cpu", sql, 1.0)
+            c = min(run_once("cpu", sql, 1.0) for _ in range(2))
+            row = {"name": label, "sf": 1.0, "tpu_ms": round(t * 1000, 1),
+                   "cpu_ms": round(c * 1000, 1), "speedup": round(c / t, 2)}
+            print(f"[config] {label}: tpu={row['tpu_ms']}ms "
+                  f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x",
+                  file=sys.stderr)
+            out.append(row)
+        except Exception as e:
+            print(f"[config] {label}: failed: {e}", file=sys.stderr)
+    return out
 
 
 def main() -> None:
     _probe_device()
-    ensure_data()
+    ensure_data(SF)
     import pyarrow.parquet as pq
 
-    rows = pq.read_metadata(
-        sorted((DATA / "lineitem").glob("*.parquet"))[0]
-    ).num_rows * len(list((DATA / "lineitem").glob("*.parquet")))
+    files = sorted((data_dir(SF) / "lineitem").glob("*.parquet"))
+    rows = pq.read_metadata(files[0]).num_rows * len(files)
 
-    # warmup (compile + caches) then best-of-3 steady state, both backends
-    run_once("tpu")
-    tpu_dt = min(run_once("tpu") for _ in range(3))
-    run_once("cpu")
-    cpu_dt = min(run_once("cpu") for _ in range(3))
+    # headline: q1 at BENCH_SF — warmup (compile + caches) then best-of-3
+    # steady state, both backends
+    q1 = (QUERIES_DIR / "q1.sql").read_text()
+    run_once("tpu", q1)
+    tpu_dt = min(run_once("tpu", q1) for _ in range(3))
+    run_once("cpu", q1)
+    cpu_dt = min(run_once("cpu", q1) for _ in range(3))
 
-    # secondary configs (stderr, not the tracked metric)
-    try:
-        from benchmarks.taxi.datagen import TRIP_AGG_QUERY, generate as taxi_gen
-
-        taxi_dir = REPO / ".bench_cache" / "taxi_sf1"
-        if not (taxi_dir / "trips").exists():
-            taxi_gen(str(taxi_dir), sf=1.0, parts=1)
-        for backend in ("tpu", "cpu"):
-            ctx = _context(backend)
-            if "trips" not in ctx.tables:
-                ctx.register_parquet("trips", str(taxi_dir / "trips"))
-        run_once("tpu", TRIP_AGG_QUERY)
-        t = min(run_once("tpu", TRIP_AGG_QUERY) for _ in range(2))
-        run_once("cpu", TRIP_AGG_QUERY)
-        c = min(run_once("cpu", TRIP_AGG_QUERY) for _ in range(2))
-        print(f"[side] taxi_10M_265groups: tpu={t*1000:.0f}ms cpu={c*1000:.0f}ms "
-              f"speedup={c/t:.2f}x", file=sys.stderr)
-
-        # high-cardinality variant: 10k zones (block-level granularity)
-        taxi_hc = REPO / ".bench_cache" / "taxi_hc_sf1"
-        if not (taxi_hc / "trips").exists():
-            taxi_gen(str(taxi_hc), sf=1.0, parts=1, n_zones=10_000)
-        hc_query = TRIP_AGG_QUERY.replace("from trips", "from trips_hc")
-        for backend in ("tpu", "cpu"):
-            ctx = _context(backend)
-            if "trips_hc" not in ctx.tables:
-                ctx.register_parquet("trips_hc", str(taxi_hc / "trips"))
-        run_once("tpu", hc_query)
-        t = min(run_once("tpu", hc_query) for _ in range(2))
-        run_once("cpu", hc_query)
-        c = min(run_once("cpu", hc_query) for _ in range(2))
-        print(f"[side] taxi_10M_10kgroups: tpu={t*1000:.0f}ms cpu={c*1000:.0f}ms "
-              f"speedup={c/t:.2f}x", file=sys.stderr)
-    except Exception as e:
-        print(f"[side] taxi: failed: {e}", file=sys.stderr)
-    for q in SIDE_QUERIES:
-        sql = (QUERIES_DIR / f"{q}.sql").read_text()
-        try:
-            run_once("tpu", sql)
-            t = min(run_once("tpu", sql), run_once("tpu", sql))
-            c = min(run_once("cpu", sql), run_once("cpu", sql))
-            print(
-                f"[side] {q}: tpu={t*1000:.0f}ms cpu={c*1000:.0f}ms "
-                f"speedup={c/t:.2f}x",
-                file=sys.stderr,
-            )
-        except Exception as e:
-            print(f"[side] {q}: failed: {e}", file=sys.stderr)
+    configs = []
+    for sf, name in CONFIGS:
+        if (sf, name) == (SF, "q1"):
+            configs.append({"name": "q1", "sf": SF,
+                            "tpu_ms": round(tpu_dt * 1000, 1),
+                            "cpu_ms": round(cpu_dt * 1000, 1),
+                            "speedup": round(cpu_dt / tpu_dt, 2)})
+            continue
+        if time.monotonic() - _T_START > MAX_SECONDS:
+            print(f"[config] {name} sf={sf}: skipped (past "
+                  f"{MAX_SECONDS:.0f}s soft deadline)", file=sys.stderr)
+            continue
+        row = bench_config(sf, name, iters=3 if sf <= 1 else 2)
+        if row is not None:
+            configs.append(row)
+    if time.monotonic() - _T_START <= MAX_SECONDS:
+        configs.extend(_taxi_rows())
 
     value = rows / tpu_dt
     baseline = rows / cpu_dt
@@ -168,6 +252,7 @@ def main() -> None:
                 "value": round(value, 1),
                 "unit": "rows/s/chip",
                 "vs_baseline": round(value / baseline, 3),
+                "configs": configs,
             }
         )
     )
