@@ -30,14 +30,19 @@ is what XLA/TPU wants.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 
-def _chunk_spans(starts: np.ndarray, lens: np.ndarray, L: int):
+def _chunk_spans(starts: np.ndarray, lens: np.ndarray, L: int,
+                 min_one_chunk: bool = True):
     """Split each group's [start, start+len) row range into chunks of <= L
     rows. Vectorized. Returns (chunk start rows [V], chunk lengths [V],
     owner group of each chunk [V], all in group order)."""
-    nchunks = np.maximum(-(-lens // L), 1)
+    nchunks = -(-lens // L)
+    if min_one_chunk:
+        nchunks = np.maximum(nchunks, 1)
     V = int(nchunks.sum())
     owner = np.repeat(np.arange(len(lens), dtype=np.int64), nchunks)
     offs = np.repeat(np.cumsum(nchunks) - nchunks, nchunks)
@@ -51,7 +56,8 @@ class SortedSegmentLayout:
     """Host-side artifact built once per partition per group-key set."""
 
     def __init__(self, codes: np.ndarray, n_groups: int,
-                 cover_max: bool = False) -> None:
+                 cover_max: bool = False, force_L1: Optional[int] = None,
+                 min_one_chunk: bool = True) -> None:
         order = np.argsort(codes, kind="stable")
         sorted_codes = codes[order]
         grid = np.arange(n_groups, dtype=np.int64)
@@ -61,14 +67,25 @@ class SortedSegmentLayout:
 
         # cover_max: one chunk per group whenever the longest run fits 1024
         # (fact-agg needs chunk partials == group partials); default: cover
-        # the 90th percentile and let fold_* handle the tail
-        target = int(lens.max()) if (cover_max and n_groups) else (
-            int(np.percentile(lens, 90)) if n_groups else 1
+        # the 90th percentile and let fold_* handle the tail.
+        # force_L1: mesh shards must share one tile width so their [V, L1]
+        # tiles stack into a single sharded array.
+        if force_L1 is not None:
+            L1 = force_L1
+        else:
+            target = int(lens.max()) if (cover_max and n_groups) else (
+                int(np.percentile(lens, 90)) if n_groups else 1
+            )
+            L1 = 8
+            while L1 < target and L1 < 1024:
+                L1 <<= 1
+        # min_one_chunk=False: groups with no rows here get NO chunk (mesh
+        # shards fold to dense [G] with in-program segment ops, which supply
+        # the identity for absent groups; the host fold_* path needs the
+        # dense chunk cover instead)
+        cstart, clen, owner = _chunk_spans(
+            starts, lens, L1, min_one_chunk=min_one_chunk
         )
-        L1 = 8
-        while L1 < target and L1 < 1024:
-            L1 <<= 1
-        cstart, clen, owner = _chunk_spans(starts, lens, L1)
 
         V = len(owner)
         idx = cstart[:, None] + np.arange(L1, dtype=np.int64)[None, :]
@@ -82,8 +99,11 @@ class SortedSegmentLayout:
         self.row_take = order[idx.reshape(-1)].reshape(V, L1)
         self.pad = pad  # bool [V, L1]
         self.owner = owner  # sorted [V]
-        self.one_chunk_per_group = V == n_groups
-        if not self.one_chunk_per_group:
+        # fold_*'s reduceat bookkeeping assumes every group owns >=1 chunk;
+        # min_one_chunk=False layouts fold in-program instead (mesh path)
+        self._host_folds = min_one_chunk
+        self.one_chunk_per_group = min_one_chunk and V == n_groups
+        if self._host_folds and not self.one_chunk_per_group:
             self._fold_starts = np.searchsorted(owner, grid)
 
     # ------------------------------------------------------------------
@@ -94,6 +114,7 @@ class SortedSegmentLayout:
 
     # ------------------------------------------------------------------
     def fold_sum(self, chunk_partials: np.ndarray) -> np.ndarray:
+        assert self._host_folds, "min_one_chunk=False layouts fold in-program"
         if self.one_chunk_per_group:
             return chunk_partials
         # widen before folding: float for accuracy, int so exact chunk sums
@@ -107,11 +128,13 @@ class SortedSegmentLayout:
         return np.add.reduceat(cp, self._fold_starts)
 
     def fold_min(self, chunk_partials: np.ndarray) -> np.ndarray:
+        assert self._host_folds, "min_one_chunk=False layouts fold in-program"
         if self.one_chunk_per_group:
             return chunk_partials
         return np.minimum.reduceat(chunk_partials, self._fold_starts)
 
     def fold_max(self, chunk_partials: np.ndarray) -> np.ndarray:
+        assert self._host_folds, "min_one_chunk=False layouts fold in-program"
         if self.one_chunk_per_group:
             return chunk_partials
         return np.maximum.reduceat(chunk_partials, self._fold_starts)

@@ -92,6 +92,32 @@ def jnp_unpack_i32(hi, lo):
     return hi.astype(jnp.int32) * 65536 + lo.astype(jnp.int32)
 
 
+def dense_rank(encoded: List[Tuple[np.ndarray, int]]):
+    """Combine per-column dictionary codes into dense row ranks.
+
+    encoded: (int64 code array, alphabet size) per key column, all arrays the
+    same length. Strides are combined with an overflow guard (repack through
+    np.unique before a multiply could overflow int64). Returns
+    (rank per row, first row index of each distinct, distinct count)."""
+    combined = None
+    card = 1
+    for codes_i, size in encoded:
+        size = max(1, size)
+        if combined is None:
+            combined, card = codes_i, size
+            continue
+        if card > (1 << 62) // size:
+            _, combined = np.unique(combined, return_inverse=True)
+            combined = combined.astype(np.int64)
+            card = int(combined.max()) + 1 if len(combined) else 1
+        combined = combined * size + codes_i
+        card *= size
+    uniq, first_idx, inv = np.unique(
+        combined, return_index=True, return_inverse=True
+    )
+    return inv, first_idx, len(uniq)
+
+
 def substitute_columns(e: px.PhysicalExpr, mapping: List[px.PhysicalExpr]) -> px.PhysicalExpr:
     """Inline projection outputs: ColumnExpr(i) -> mapping[i]."""
     if isinstance(e, px.ColumnExpr):
@@ -452,24 +478,9 @@ class FusedAggregateStage:
                 uniq_rows.append(dv.take(pa.array(np.minimum(pcodes, max(0, len(dv) - 1)))))
             return combined.astype(np.int32), uniq_rows, card
 
-        combined = None
-        card = 1
-        for codes_i, dv in encoded:
-            size = max(1, len(dv))
-            if combined is None:
-                combined, card = codes_i, size
-                continue
-            if card > (1 << 62) // size:
-                # repack to dense codes before multiplying (overflow guard)
-                _, combined = np.unique(combined, return_inverse=True)
-                combined = combined.astype(np.int64)
-                card = int(combined.max()) + 1 if len(combined) else 1
-            combined = combined * size + codes_i
-            card *= size
-        _uniq, first_idx, inv = np.unique(
-            combined, return_index=True, return_inverse=True
+        inv, first_idx, n_groups = dense_rank(
+            [(codes_i, len(dv)) for codes_i, dv in encoded]
         )
-        n_groups = len(_uniq)
         # key values for each distinct group = the first row bearing it
         take_idx = pa.array(first_idx.astype(np.int64))
         uniq_rows = [
@@ -502,18 +513,24 @@ class FusedAggregateStage:
             return
         yield from self.scan.execute(partition, ctx)
 
-    def _check_int_ranges(self, batch_cols: Dict[int, np.ndarray], n: int) -> None:
-        """Integer sums accumulate in int32 on device; decline when a
-        whole-batch masked sum could overflow (ADVICE r1: silent f32
-        rounding of integer aggregates)."""
+    def _check_int_ranges(self, batch_cols, n: int) -> None:
+        """Integer sums accumulate in int32 on device; decline when a masked
+        sum over n rows could overflow (ADVICE r1: silent f32 rounding of
+        integer aggregates). batch_cols: one Dict[int, np.ndarray], or a list
+        of them when the sum spans several mesh shards (psum adds across
+        shards, so the bound uses the GLOBAL row count)."""
+        col_dicts = batch_cols if isinstance(batch_cols, list) else [batch_cols]
         for a, ie, ix in zip(self.aggs, self.agg_inputs, self.int_exact):
             if not ix or a.fn not in ("sum", "avg"):
                 continue
-            npcol = batch_cols.get(ie.index)
-            if npcol is None or len(npcol) == 0:
-                continue
-            bound = max(abs(int(npcol.max())), abs(int(npcol.min()))) * n
-            if bound > _INT32_MAX:
+            maxabs = 0
+            for bc in col_dicts:
+                npcol = bc.get(ie.index)
+                if npcol is not None and len(npcol):
+                    maxabs = max(
+                        maxabs, abs(int(npcol.max())), abs(int(npcol.min()))
+                    )
+            if maxabs * n > _INT32_MAX:
                 raise UnsupportedOnDevice(
                     f"int32 sum over column {ie.name!r} may overflow"
                 )

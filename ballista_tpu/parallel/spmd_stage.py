@@ -9,6 +9,23 @@ on each shard, and the exchange is lax.psum over the mesh's ICI — no
 materialize-then-fetch, one XLA program for the whole
 Partial->shuffle->Final pipeline.
 
+Distributed structure (nothing is globally gathered in row space):
+
+  1. per-shard reads — input partition p belongs to mesh shard
+     p % n_devices; each shard scans, encodes, and group-codes only its
+     own rows (on a multi-host mesh each host would run this for the
+     shards it owns — the per-shard decomposition is the multi-host story).
+  2. two-pass global key coding — shards exchange only their DISTINCT key
+     rows; the union is dense-ranked once (host work proportional to
+     distinct-key count, not row count) and each shard remaps its local
+     codes through its slice of the ranking. No central row dictionary.
+  3. one mesh program — per-shard fused partials, then the exchange:
+       G <= 1024: unrolled per-group reductions + psum/pmin/pmax.
+       G  > 1024: per-shard sorted chunked-segment tiles (ops/layout.py)
+       -> per-chunk partials -> in-program segment fold to dense [G]
+       (owners are sorted, V is small) -> psum/pmin/pmax over the mesh.
+     Either way ONE compiled program and ONE device->host readback.
+
 SpmdAggregateExec is emitted by the DistributedPlanner (config
 `ballista.tpu.spmd_stages` = true) in place of the
 HashAggregate(Final) <- Repartition(hash) <- HashAggregate(Partial)
@@ -32,6 +49,39 @@ from ballista_tpu.physical.plan import (
     batch_table,
     collect_all,
 )
+
+def _rank_rows(columns):
+    """Dense-rank the rows of a small key table (the union of per-shard
+    distinct keys). Returns (rank per input row [int32], per-column unique
+    key arrays in rank order, n_groups). Work is O(K log K) in the number
+    of distinct-key candidates, never in the number of data rows."""
+    import pyarrow.compute as pc
+
+    from ballista_tpu.ops.stage import dense_rank
+
+    if not columns:
+        return np.zeros(0, dtype=np.int32), [], 1
+    encoded = []
+    for arr in columns:
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        d = arr if isinstance(arr, pa.DictionaryArray) else pc.dictionary_encode(arr)
+        encoded.append(
+            (d.indices.to_numpy(zero_copy_only=False).astype(np.int64), d)
+        )
+    inv, first_idx, n_uniq = dense_rank(
+        [(codes_i, len(d.dictionary)) for codes_i, d in encoded]
+    )
+    take = pa.array(first_idx.astype(np.int64))
+    uniq_rows = []
+    for arr, (_c, d) in zip(columns, encoded):
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if isinstance(arr, pa.DictionaryArray):
+            uniq_rows.append(d.dictionary.take(d.indices.take(take)))
+        else:
+            uniq_rows.append(arr.take(take))
+    return inv.astype(np.int32), uniq_rows, n_uniq
 
 
 class SpmdAggregateExec(ExecutionPlan):
@@ -103,35 +153,63 @@ class SpmdAggregateExec(ExecutionPlan):
             self._mesh = build_mesh({"data": len(jax.devices())})
         return self._mesh
 
+    def fingerprint(self) -> str:
+        """Stable short id of the fused subtree, for fallback diagnostics."""
+        import hashlib
+
+        def walk(n):
+            yield n.fmt()
+            for c in n.children():
+                yield from walk(c)
+
+        text = "\n".join(walk(self.subplan))
+        return hashlib.sha1(text.encode()).hexdigest()[:12]
+
+    _warned_fingerprints: set = set()
+
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        from ballista_tpu.utils import tracing
+
         assert partition == 0
         if ctx.backend != "tpu":
-            yield from self.subplan.execute(partition, ctx)
+            yield from self._execute_host(ctx)
             return
         try:
             out = self._execute_mesh(ctx)
             self.last_path = "mesh"
+            tracing.incr("spmd.mesh")
         except Exception:  # device decline of any kind -> host subplan
             from ballista_tpu.ops.runtime import UnsupportedOnDevice
             import logging
             import sys
 
             exc = sys.exc_info()[1]
+            tracing.incr("spmd.host_fallback")
             if not isinstance(exc, UnsupportedOnDevice):
-                logging.getLogger("ballista.spmd").warning(
-                    "mesh aggregation failed, host fallback: %s", exc
-                )
+                tracing.incr("spmd.host_fallback_error")
+                fp = self.fingerprint()
+                if fp not in self._warned_fingerprints:
+                    self._warned_fingerprints.add(fp)
+                    logging.getLogger("ballista.spmd").warning(
+                        "mesh aggregation failed (stage %s), host fallback: %s",
+                        fp, exc,
+                    )
             self.last_path = "host"
-            yield from self.subplan.execute(partition, ctx)
+            yield from self._execute_host(ctx)
             return
         yield from batch_table(out, ctx.batch_size)
 
+    def _execute_host(self, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        """Run the untouched subtree on the host. The Final aggregate above
+        the hash Repartition spreads groups over ALL its output partitions —
+        this single-partition stage must drain every one of them."""
+        yield from batch_table(collect_all(self.subplan, ctx), ctx.batch_size)
+
     # ------------------------------------------------------------------
     def _execute_mesh(self, ctx: TaskContext) -> pa.Table:
-        import jax
         import jax.numpy as jnp
 
-        from ballista_tpu.ops.runtime import UnsupportedOnDevice, bucket_rows, pad_to
+        from ballista_tpu.ops.runtime import UnsupportedOnDevice, bucket_rows
         from ballista_tpu.ops.stage import FusedAggregateStage, MAX_GROUPS
 
         if self._stage is None:
@@ -140,47 +218,174 @@ class SpmdAggregateExec(ExecutionPlan):
         mesh = self._build_mesh(ctx)
         n_dev = int(np.prod(list(mesh.shape.values())))
 
-        # host: read every input partition, compute GLOBAL group codes so a
-        # group id means the same thing on every shard
+        # ---- 1. per-shard reads: each shard scans and group-codes ONLY its
+        # own rows. Batches go to the least-loaded shard (batches are finer
+        # than partitions, so skewed or few partitions still balance — shard
+        # blocks are padded to the largest shard, so balance is wall-time)
         parts = stage.scan.output_partitioning().partition_count()
-        batches = []
+        shard_batches: List[List[pa.RecordBatch]] = [[] for _ in range(n_dev)]
+        shard_rows = [0] * n_dev
         for p in range(parts):
-            batches.extend(b for b in stage._scan_batches(p, ctx) if b.num_rows)
-        if not batches:
+            for b in stage._scan_batches(p, ctx):
+                if not b.num_rows:
+                    continue
+                si = shard_rows.index(min(shard_rows))
+                shard_batches[si].append(b)
+                shard_rows[si] += b.num_rows
+        shards: List[Optional[dict]] = []
+        for bs in shard_batches:
+            if not bs:
+                shards.append(None)  # empty shard: identity contribution
+                continue
+            t = pa.Table.from_batches(bs).combine_chunks()
+            batch = t.to_batches(max_chunksize=t.num_rows)[0]
+            codes, kv, g = stage._group_codes(batch)
+            shards.append({"batch": batch, "codes": codes, "kv": kv, "g": g})
+        live = [d for d in shards if d is not None]
+        if not live:
             return self.schema().empty_table()
-        table = pa.Table.from_batches(batches).combine_chunks()
-        batch = table.to_batches(max_chunksize=table.num_rows)[0]
-        codes, key_values, n_groups = stage._group_codes(batch)
+
+        # ---- 2. global key coding from per-shard DISTINCTS only
+        n_keys = len(stage.group_exprs)
+        if n_keys == 0:
+            n_groups, gkv = 1, []
+            for d in live:
+                d["gcodes"] = d["codes"]
+        else:
+            union_cols = []
+            for j in range(n_keys):
+                parts_j = []
+                for d in live:
+                    a = d["kv"][j]
+                    parts_j.append(
+                        a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                    )
+                union_cols.append(
+                    pa.chunked_array(parts_j).combine_chunks()
+                    if len(parts_j) > 1 else parts_j[0]
+                )
+            inv, gkv, n_groups = _rank_rows(union_cols)
+            off = 0
+            for d in live:
+                mapping = inv[off:off + d["g"]]
+                off += d["g"]
+                d["gcodes"] = mapping[d["codes"]]
         if n_groups == 0:
             return self.schema().empty_table()
-        if n_groups > MAX_GROUPS:
-            raise UnsupportedOnDevice("mesh path uses unrolled reductions")
-        npcols = stage._lower_columns(batch)
-        stage._check_int_ranges(npcols, batch.num_rows)
 
-        # shard rows across the mesh: equal-size padded shards
-        n = batch.num_rows
-        shard = bucket_rows(-(-n // n_dev))
-        total = shard * n_dev
-        cols: Dict[int, object] = {}
-        for idx, npcol in npcols.items():
-            fill = False if npcol.dtype == np.bool_ else 0
-            cols[idx] = jnp.asarray(pad_to(npcol, total, fill))
-        codes_pad = jnp.asarray(pad_to(codes.astype(np.int32), total, 0))
-        row_valid = np.zeros(total, dtype=np.bool_)
-        row_valid[:n] = True
-        row_valid = jnp.asarray(row_valid)
+        # ---- 3. lower columns per shard; global int32-sum overflow check
+        # (psum adds across shards, so the bound spans ALL rows)
+        for d in live:
+            d["npcols"] = stage._lower_columns(d["batch"])
+        total_n = sum(d["batch"].num_rows for d in live)
+        stage._check_int_ranges([d["npcols"] for d in live], total_n)
+
         aux = [jnp.asarray(a) for a in stage.compiler.build_aux()]
+        if n_groups <= MAX_GROUPS:
+            counts, outputs = self._run_unrolled_mesh(
+                mesh, stage, shards, n_groups, n_dev, aux
+            )
+        else:
+            counts, outputs = self._run_sorted_mesh(
+                mesh, stage, shards, n_groups, n_dev, aux
+            )
+        partial_table = stage._assemble_partial(outputs, counts, gkv, n_groups)
+        return self.final._final(partial_table)
+
+    def _run_unrolled_mesh(self, mesh, stage, shards, n_groups, n_dev, aux):
+        """G <= MAX_GROUPS: per-shard unrolled reductions + psum exchange.
+        Shard blocks are padded to a common size and laid out contiguously,
+        so shard d's rows live exactly in block d of the sharded arrays."""
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.runtime import bucket_rows
+
+        live_ns = [d["batch"].num_rows for d in shards if d is not None]
+        S = int(bucket_rows(max(live_ns)))
+        total = S * n_dev
+        col_ids = sorted(stage.compiler.used_columns)
+        cols: Dict[int, object] = {}
+        for idx in col_ids:
+            ref = next(d["npcols"][idx] for d in shards if d is not None)
+            big = np.zeros(total, dtype=ref.dtype)
+            for si, d in enumerate(shards):
+                if d is not None:
+                    npcol = d["npcols"][idx]
+                    big[si * S: si * S + len(npcol)] = npcol
+            cols[idx] = jnp.asarray(big)
+        codes_big = np.zeros(total, dtype=np.int32)
+        valid_big = np.zeros(total, dtype=np.bool_)
+        for si, d in enumerate(shards):
+            if d is None:
+                continue
+            n = d["batch"].num_rows
+            codes_big[si * S: si * S + n] = d["gcodes"]
+            valid_big[si * S: si * S + n] = True
 
         seg = int(bucket_rows(n_groups, 16)) + 1  # +1 dump slot
         program = self._get_program(mesh, stage, seg, set(cols.keys()), len(aux))
-        stacked = np.asarray(program(cols, aux, codes_pad, row_valid))
-
+        stacked = np.asarray(
+            program(cols, aux, jnp.asarray(codes_big), jnp.asarray(valid_big))
+        )
         rows = stage._decode_stacked(stacked)
-        counts = rows[0][:n_groups]
-        outputs = [r[:n_groups] for r in rows[1:]]
-        partial_table = stage._assemble_partial(outputs, counts, key_values, n_groups)
-        return self.final._final(partial_table)
+        return rows[0][:n_groups], [r[:n_groups] for r in rows[1:]]
+
+    def _run_sorted_mesh(self, mesh, stage, shards, n_groups, n_dev, aux):
+        """G > MAX_GROUPS: per-shard sorted chunked-segment tiles, chunk
+        partials folded to dense [G] in-program (sorted segment ops over a
+        small V), then psum/pmin/pmax over the mesh. Cardinality-independent:
+        device work is O(rows + G), never O(G) serial passes."""
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.layout import SortedSegmentLayout
+        from ballista_tpu.ops.runtime import bucket_rows
+
+        layouts: List[Optional[SortedSegmentLayout]] = []
+        for d in shards:
+            layouts.append(
+                None if d is None else SortedSegmentLayout(
+                    d["gcodes"], n_groups, min_one_chunk=False
+                )
+            )
+        live_layouts = [l for l in layouts if l is not None]
+        L1 = max(l.L1 for l in live_layouts)
+        for i, (d, l) in enumerate(zip(shards, layouts)):
+            if l is not None and l.L1 != L1:
+                layouts[i] = SortedSegmentLayout(
+                    d["gcodes"], n_groups, force_L1=L1, min_one_chunk=False
+                )
+        V_pad = int(bucket_rows(max(l.V for l in layouts if l is not None), 8))
+        G_pad = int(bucket_rows(n_groups, 16))
+
+        col_ids = sorted(stage.compiler.used_columns)
+        cols: Dict[int, object] = {}
+        for idx in col_ids:
+            ref = next(d["npcols"][idx] for d in shards if d is not None)
+            big = np.zeros((n_dev * V_pad, L1), dtype=ref.dtype)
+            for si, (d, l) in enumerate(zip(shards, layouts)):
+                if d is not None and l.V:
+                    big[si * V_pad: si * V_pad + l.V] = l.materialize(
+                        d["npcols"][idx]
+                    )
+            cols[idx] = jnp.asarray(big)
+        pad_big = np.zeros((n_dev * V_pad, L1), dtype=np.bool_)
+        # padding chunks carry identity partials (pad=False), so any segment
+        # may absorb them — use G_pad-1 to keep each shard's owner slice
+        # SORTED (the segment ops are called with indices_are_sorted=True)
+        owner_big = np.full(n_dev * V_pad, G_pad - 1, dtype=np.int32)
+        for si, l in enumerate(layouts):
+            if l is not None and l.V:
+                pad_big[si * V_pad: si * V_pad + l.V] = l.pad
+                owner_big[si * V_pad: si * V_pad + l.V] = l.owner
+
+        program = self._get_sorted_program(
+            mesh, stage, G_pad, set(cols.keys()), len(aux)
+        )
+        stacked = np.asarray(
+            program(cols, aux, jnp.asarray(pad_big), jnp.asarray(owner_big))
+        )
+        rows = stage._decode_stacked(stacked)
+        return rows[0][:n_groups], [r[:n_groups] for r in rows[1:]]
 
     def _get_program(self, mesh, stage, seg: int, col_keys, n_aux: int):
         """shard_map(per-shard fused partials) + psum, jitted once per
@@ -221,6 +426,72 @@ class SpmdAggregateExec(ExecutionPlan):
                 else:
                     outs.append(red(stacked[p], "data"))
                     p += 1
+            return jnp.stack(outs)
+
+        fn = shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(
+                {k: P("data") for k in col_keys},
+                [P() for _ in range(n_aux)],
+                P("data"),
+                P("data"),
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )
+        self._program = jax.jit(fn)
+        self._program_key = key
+        return self._program
+
+    def _get_sorted_program(self, mesh, stage, G_pad: int, col_keys, n_aux: int):
+        """shard_map(per-shard tile partials -> sorted segment fold to dense
+        [G_pad]) + psum/pmin/pmax exchange, jitted once per (group bucket,
+        column set). Chunk owners are sorted within each shard, and V is
+        orders of magnitude smaller than the row count, so the in-program
+        segment ops stay cheap even though XLA lowers them to scatter."""
+        key = ("sorted", G_pad, tuple(sorted(col_keys)), n_aux)
+        if self._program_key == key:
+            return self._program
+
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from ballista_tpu.ops.stage import jnp_unpack_i32
+
+        core = stage._sorted_core()
+        int_rows = stage._int_rows
+        folds = stage._folds
+        seg_ops = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+                   "max": jax.ops.segment_max}
+        collectives = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                       "max": jax.lax.pmax}
+
+        def per_shard(cols, aux, pad, owner):
+            stacked = core(cols, aux, pad)  # [R_packed, V] chunk partials
+            outs = []
+            p = 0
+            for is_int, fold in zip(int_rows, folds):
+                if is_int:
+                    v = jnp_unpack_i32(stacked[p], stacked[p + 1])
+                    p += 2
+                else:
+                    v = stacked[p]
+                    p += 1
+                # chunk -> dense group vector (segment identity covers
+                # groups this shard never saw), then the mesh exchange
+                dense = seg_ops[fold](
+                    v, owner, num_segments=G_pad, indices_are_sorted=True
+                )
+                dense = collectives[fold](dense, "data")
+                if is_int:
+                    dense = dense.astype(jnp.int32)
+                    outs.append((dense >> 16).astype(jnp.float32))
+                    outs.append((dense & 0xFFFF).astype(jnp.float32))
+                else:
+                    outs.append(dense)
             return jnp.stack(outs)
 
         fn = shard_map(

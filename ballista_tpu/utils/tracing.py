@@ -22,6 +22,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 _local = threading.local()
 _all_spans: List[Tuple[str, float, int]] = []  # (path, seconds, depth)
+_counters: Dict[str, int] = {}
 _mu = threading.Lock()
 
 
@@ -69,6 +70,19 @@ def spans() -> List[Tuple[str, float, int]]:
         return list(_all_spans)
 
 
+def incr(name: str, by: int = 1) -> None:
+    """Monotonic named counter (e.g. spmd.mesh vs spmd.host_fallback, so a
+    permanently-broken mesh path is visible in ops, not just test asserts)."""
+    with _mu:
+        _counters[name] = _counters.get(name, 0) + by
+
+
+def counters() -> Dict[str, int]:
+    with _mu:
+        return dict(_counters)
+
+
 def reset() -> None:
     with _mu:
         _all_spans.clear()
+        _counters.clear()

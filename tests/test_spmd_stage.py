@@ -134,6 +134,183 @@ def test_mesh_program_matches_host():
     )
 
 
+def _find_spmd(stages):
+    def find(n):
+        if isinstance(n, SpmdAggregateExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    return next(s for s in (find(st) for st in stages) if s is not None)
+
+
+def _run_spmd(table, group_cols, aggs, n_partitions=4, settings=SPMD_SETTINGS):
+    from ballista_tpu.physical.plan import TaskContext
+
+    cfg = BallistaConfig(settings)
+    ctx = ExecutionContext(cfg)
+    ctx.register_record_batches("t", table, n_partitions=n_partitions)
+    df = ctx.table("t").aggregate([col(c) for c in group_cols], aggs)
+    phys = ctx.create_physical_plan(df.logical_plan())
+    spmd = _find_spmd(DistributedPlanner(cfg).plan_query_stages("job", phys))
+    tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+    out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
+    return spmd, out
+
+
+def test_mesh_high_cardinality_takes_mesh_path():
+    """>=100k groups run the sorted chunked-segment mesh path (per-shard
+    reads + in-program segment fold + psum), matching the host oracle —
+    the unrolled path's 1024-group ceiling does not apply to the mesh."""
+    rng = np.random.default_rng(7)
+    N, G = 300_000, 130_000
+    table = pa.table(
+        {
+            "k": pa.array(rng.integers(0, G, N).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 100, N)),
+            "q": pa.array(rng.integers(1, 50, N).astype(np.int64)),
+        }
+    )
+    spmd, out = _run_spmd(
+        table, ["k"],
+        [F.sum(col("v")).alias("s"), F.count(col("q")).alias("c"),
+         F.min(col("v")).alias("mn"), F.sum(col("q")).alias("sq")],
+        n_partitions=5,  # 5 partitions over 8 shards: empty shards included
+    )
+    assert spmd.last_path == "mesh"
+    ora = (
+        table.group_by("k")
+        .aggregate([("v", "sum"), ("q", "count"), ("v", "min"), ("q", "sum")])
+        .sort_by("k")
+    )
+    got = out.sort_by("k")
+    assert got.num_rows == ora.num_rows > 100_000
+    np.testing.assert_array_equal(
+        got.column("k").to_numpy(), ora.column("k").to_numpy()
+    )
+    np.testing.assert_array_equal(
+        got.column("c").to_numpy(), ora.column("q_count").to_numpy()
+    )
+    np.testing.assert_array_equal(
+        got.column("sq").to_numpy(), ora.column("q_sum").to_numpy()
+    )
+    np.testing.assert_allclose(
+        got.column("s").to_numpy(), ora.column("v_sum").to_numpy(), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        got.column("mn").to_numpy(), ora.column("v_min").to_numpy(), rtol=1e-6
+    )
+
+
+def test_mesh_multi_column_key():
+    """Composite group keys get globally-consistent codes from the
+    per-shard-distincts union ranking."""
+    rng = np.random.default_rng(11)
+    n = 6000
+    table = pa.table(
+        {
+            "region": pa.array(
+                np.array(["east", "west", "north", "south"])[rng.integers(0, 4, n)]
+            ),
+            "tier": pa.array(rng.integers(0, 7, n).astype(np.int64)),
+            "amount": pa.array(rng.uniform(0, 100, n)),
+        }
+    )
+    spmd, out = _run_spmd(
+        table, ["region", "tier"],
+        [F.sum(col("amount")).alias("s"), F.count(col("amount")).alias("c")],
+        n_partitions=6,
+    )
+    assert spmd.last_path == "mesh"
+    ora = (
+        table.group_by(["region", "tier"])
+        .aggregate([("amount", "sum"), ("amount", "count")])
+        .sort_by([("region", "ascending"), ("tier", "ascending")])
+    )
+    got = out.sort_by([("region", "ascending"), ("tier", "ascending")])
+    assert got.column("region").to_pylist() == ora.column("region").to_pylist()
+    assert got.column("tier").to_pylist() == ora.column("tier").to_pylist()
+    assert got.column("c").to_pylist() == ora.column("amount_count").to_pylist()
+    np.testing.assert_allclose(
+        got.column("s").to_numpy(), ora.column("amount_sum").to_numpy(),
+        rtol=1e-4,
+    )
+
+
+def test_mesh_fewer_partitions_than_devices():
+    """Empty shards contribute the identity; results stay exact."""
+    table = _sales(n=500, seed=5)
+    spmd, out = _run_spmd(
+        table, ["region"],
+        [F.sum(col("qty")).alias("sq"), F.max(col("amount")).alias("mx")],
+        n_partitions=2,  # 6 of 8 shards empty
+    )
+    assert spmd.last_path == "mesh"
+    ora = (
+        table.group_by("region")
+        .aggregate([("qty", "sum"), ("amount", "max")])
+        .sort_by("region")
+    )
+    got = out.sort_by("region")
+    assert got.column("sq").to_pylist() == ora.column("qty_sum").to_pylist()
+    np.testing.assert_allclose(
+        got.column("mx").to_numpy(), ora.column("amount_max").to_numpy(),
+        rtol=1e-6,
+    )
+
+
+def test_mesh_failure_falls_back_and_is_surfaced(monkeypatch, caplog):
+    """A broken mesh path must not be invisible: the host fallback still
+    returns correct rows, the tracing counter increments, and a warning
+    with the stage fingerprint is logged once."""
+    import logging
+
+    from ballista_tpu.physical.plan import TaskContext
+    from ballista_tpu.utils import tracing
+
+    table = _sales(n=800, seed=9)
+    cfg = BallistaConfig(SPMD_SETTINGS)
+    ctx = ExecutionContext(cfg)
+    ctx.register_record_batches("t", table, n_partitions=3)
+    df = ctx.table("t").aggregate(
+        [col("region")], [F.sum(col("amount")).alias("s")]
+    )
+    phys = ctx.create_physical_plan(df.logical_plan())
+    spmd = _find_spmd(DistributedPlanner(cfg).plan_query_stages("job", phys))
+
+    def boom(ctx):
+        raise RuntimeError("injected mesh failure")
+
+    monkeypatch.setattr(spmd, "_execute_mesh", boom)
+    SpmdAggregateExec._warned_fingerprints.clear()
+    tracing.reset()
+    tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+    with caplog.at_level(logging.WARNING, logger="ballista.spmd"):
+        out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
+    assert spmd.last_path == "host"
+    c = tracing.counters()
+    assert c.get("spmd.host_fallback") == 1
+    assert c.get("spmd.host_fallback_error") == 1
+    assert c.get("spmd.mesh") is None
+    assert any("injected mesh failure" in r.message and spmd.fingerprint()
+               in r.message for r in caplog.records)
+    ora = table.group_by("region").aggregate([("amount", "sum")]).sort_by("region")
+    got = out.sort_by("region")
+    np.testing.assert_allclose(
+        got.column("s").to_numpy(), ora.column("amount_sum").to_numpy(),
+        rtol=1e-4,
+    )
+    # a second failure on the same stage does not re-warn
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="ballista.spmd"):
+        list(spmd.execute(0, tctx))
+    assert not caplog.records
+    assert tracing.counters().get("spmd.host_fallback") == 2
+
+
 def test_distributed_spmd_end_to_end(sales_table):
     """Full path: BallistaContext -> scheduler -> DistributedPlanner(spmd) ->
     executor runs the mesh program -> client fetches the result."""
