@@ -111,6 +111,11 @@ class MemoryTableSource(TableSource):
     @classmethod
     def from_table(cls, table: pa.Table, n_partitions: int = 1) -> "MemoryTableSource":
         batches = table.to_batches()
+        if 1 < n_partitions and len(batches) < n_partitions and table.num_rows:
+            # a single-chunk table would otherwise land whole in partition 0
+            # and leave the rest empty — split rows evenly instead
+            chunk = -(-table.num_rows // n_partitions)
+            batches = table.combine_chunks().to_batches(max_chunksize=chunk)
         parts: List[List[pa.RecordBatch]] = [[] for _ in range(n_partitions)]
         for i, b in enumerate(batches):
             parts[i % n_partitions].append(b)

@@ -468,16 +468,30 @@ class FactAggregateStage:
                 + packed[-2].astype(np.int64)
             )
             sel, idx, scores = sel[:, valid], idx[valid], scores[valid]
-            # With secondary sort keys the result is deterministic: if the
-            # candidate-pool boundary sits inside a tie run, groups outside
-            # the pool could legitimately outrank pool members on the
-            # tie-breakers — fall back to the host plan for this query.
+            # A tie at the k-th score reaching the candidate-pool edge means
+            # the pool may not contain every qualifying group. Two causes:
+            # - strict (secondary sort keys): groups outside the pool could
+            #   legitimately outrank pool members on the tie-breakers.
+            # - integer SUM scores (ADVICE r2): ranking casts the exact int
+            #   to f32; above 2^24 distinct sums collapse into FALSE ties.
+            #   f32 rounding is monotone, so a wrongly-excluded group forces
+            #   f32(kth) <= f32(pool edge) — exactly this condition. Within
+            #   the pool the upper Sort re-orders on exact decoded ints, so
+            #   only pool exclusion needs the fallback.
             k = self.topk["k"]
+            tie_val = scores[min(k - 1, len(scores) - 1)] if len(scores) else 0.0
+            # int scores below 2^24 are exact in f32: a boundary tie there
+            # is GENUINE, and non-strict genuine ties may break arbitrarily
+            # — only the collapse-prone magnitudes force the fallback
+            score_exact_risk = (
+                self.inner._int_rows[self._score_row()]
+                and abs(float(tie_val)) >= float(1 << 24)
+            )
             if (
-                self.topk.get("strict")
+                (self.topk.get("strict") or score_exact_risk)
                 and valid.all()
                 and len(scores) > k
-                and scores[min(k - 1, len(scores) - 1)] <= scores[-1]
+                and tie_val <= scores[-1]
             ):
                 raise UnsupportedOnDevice("top-k tie at candidate boundary")
             # map selected ranks back to dim rows

@@ -312,6 +312,13 @@ class EmptyExec(ExecutionPlan):
         self._schema = schema
 
     def schema(self) -> pa.Schema:
+        if self.produce_one_row and len(self._schema) == 0:
+            # a zero-column batch cannot carry a row count in Arrow; the
+            # one-row case declares (and emits) a placeholder null column so
+            # FROM-less SELECTs see num_rows == 1 AND consumers that trust
+            # the declared schema (e.g. shuffle writers opening IPC files)
+            # match the emitted batches
+            return pa.schema([pa.field("__placeholder", pa.null())])
         return self._schema
 
     def output_partitioning(self) -> Partitioning:
@@ -319,12 +326,7 @@ class EmptyExec(ExecutionPlan):
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         if self.produce_one_row:
-            schema = self._schema
-            if len(schema) == 0:
-                # a zero-column batch cannot carry a row count in Arrow;
-                # emit a placeholder null column so FROM-less SELECTs (pure
-                # projections over this one row) see num_rows == 1
-                schema = pa.schema([pa.field("__placeholder", pa.null())])
+            schema = self.schema()
             arrays = [pa.nulls(1, type=f.type) for f in schema]
             yield pa.RecordBatch.from_arrays(arrays, schema=schema)
 

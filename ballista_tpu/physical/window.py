@@ -200,8 +200,6 @@ class WindowExec(ExecutionPlan):
                 )
             kv = karr.to_numpy(zero_copy_only=False).astype(np.float64)[order]
             running = fstart is None
-            if np.isnan(kv).any():
-                raise PlanError("RANGE frames require non-null order keys")
             asc = f.order_by[0][1]
             sign = 1.0 if asc else -1.0
             kvs = kv * sign  # ascending view of the ordering
@@ -209,17 +207,30 @@ class WindowExec(ExecutionPlan):
             hi = np.empty(n, dtype=np.int64)
             for s0, e0 in zip(starts_idx, seg_ends):
                 seg = kvs[s0:e0]
-                cur = seg
-                lo[s0:e0] = (
+                # NULL order keys (NaN here) sort to the end of each
+                # partition (sort_indices null_placement at_end) and form
+                # one trailing peer group: offset bounds resolve to the
+                # peer run itself, UNBOUNDED bounds keep the partition edge
+                nan = np.isnan(seg)
+                nn = int((~nan).sum())
+                if nan[:nn].any():
+                    raise PlanError(
+                        "RANGE frames: non-contiguous null order keys"
+                    )
+                sub = seg[:nn]
+                lo[s0:s0 + nn] = (
                     s0
                     if fstart is None
-                    else s0 + np.searchsorted(seg, cur + fstart, side="left")
+                    else s0 + np.searchsorted(sub, sub + fstart, side="left")
                 )
-                hi[s0:e0] = (
+                hi[s0:s0 + nn] = (
                     e0
                     if fend is None
-                    else s0 + np.searchsorted(seg, cur + fend, side="right")
+                    else s0 + np.searchsorted(sub, sub + fend, side="right")
                 )
+                if nn < e0 - s0:
+                    lo[s0 + nn:e0] = s0 if fstart is None else s0 + nn
+                    hi[s0 + nn:e0] = e0
             explicit = (lo, hi)
         nparts = int(part_id[-1]) + 1
         if (fstart, fend) == (None, None) and explicit is None:
@@ -350,11 +361,15 @@ def _framed_aggregate(
                 res = np.where(nonempty, red(a, b), fill)
             out[s0:e0] = res
             continue
-        # clamp offsets to the segment so a huge frame bound costs O(m),
-        # not O(bound)
+        # clamp offsets into [-m, m] so a huge frame bound costs O(m), not
+        # O(bound). Clamping BOTH directions (not just toward the segment)
+        # keeps cs <= ce for any start <= end frame, so the sliding-window
+        # width below stays positive even for a same-side frame wider than
+        # the segment (e.g. 5 FOLLOWING..10 FOLLOWING over 3 rows — its
+        # windows then index only fill padding and yield NULL)
         iseg = np.arange(m)
-        cs = None if start is None else max(start, -m)
-        ce = None if end is None else min(end, m)
+        cs = None if start is None else min(max(start, -m), m)
+        ce = None if end is None else min(max(end, -m), m)
         if cs is None and ce is None:
             out[s0:e0] = acc(seg)[-1] if m else fill
         elif cs is None:
@@ -367,6 +382,10 @@ def _framed_aggregate(
             out[s0:e0] = run[np.clip(iseg + cs, 0, m - 1)]
             if cs > 0:
                 out[s0:e0][iseg + cs > m - 1] = fill
+        elif ce - cs + 1 <= 0:
+            # only reachable for an inverted frame (start > end); clamping
+            # preserves bound order, so well-formed frames never land here
+            out[s0:e0] = fill
         else:
             w = ce - cs + 1
             pad_before = -min(cs, 0)

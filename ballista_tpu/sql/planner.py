@@ -841,16 +841,12 @@ class SelectPlanner:
                     zero = lx.Literal(0, pa.int64())
                     cond = lx.BinaryExpr(ncol, "eq" if negated else "gt", zero)
                     filtered = lp.Filter(joined, cond)
-                    # alias kept columns back to their FLAT names so shared
-                    # bare names across join sides stay unambiguous
+                    # alias kept columns back to their FLAT names; the bare
+                    # Column resolves each flat name EXACTLY (outer schema
+                    # names are unique), so a legitimate dot inside an
+                    # output name is not misread as qualifier.column
                     keep = [
-                        lx.Alias(
-                            lx.Column(
-                                f.name.split(".")[-1],
-                                f.name.split(".")[0] if "." in f.name else None,
-                            ),
-                            f.name,
-                        )
+                        lx.Alias(lx.Column(f.name), f.name)
                         for f in outer_schema
                     ]
                     return lp.Projection(filtered, keep)

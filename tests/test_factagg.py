@@ -223,3 +223,73 @@ def test_planner_annotates_topk(star):
     assert agg is not None
     tk = getattr(agg, "_topk_pushdown", None)
     assert tk == {"agg_index": 0, "descending": True, "k": 15, "strict": False}
+
+
+def test_topk_int_sum_f32_collapse_boundary(tmp_path):
+    """Integer SUM scores rank as f32 on device; above 2^24 distinct sums
+    collapse into false ties (ADVICE r2). A collapse run spanning the
+    candidate-pool boundary must fall back to the host plan, not silently
+    return a smaller true sum."""
+    import pyarrow.parquet as pq
+
+    base = 1 << 25  # f32 ulp here is 4: base and base+1 collapse
+    G = 4000
+    sums = np.full(G, base, dtype=np.int64)
+    sums[:5] = base + 1000 * (np.arange(5) + 1)  # distinct in f32
+    # true 6th-largest f32-ties the base crowd; its HIGH index keeps it out
+    # of the (index-stable) device top-k unless the tie check fires
+    sums[G - 1] = base + 1
+    rng = np.random.default_rng(0)
+    fact = pa.table(
+        {
+            "fk": pa.array(np.arange(G), type=pa.int64()),
+            "amount": pa.array(sums, type=pa.int64()),
+            # incompressible filler so the fact file outweighs the dim file
+            # (fact selection picks the largest scan chain)
+            "pad1": pa.array(rng.uniform(0, 1, G)),
+            "pad2": pa.array(rng.uniform(0, 1, G)),
+            "pad3": pa.array(rng.uniform(0, 1, G)),
+        }
+    )
+    dim = pa.table({"dk": pa.array(np.arange(G), type=pa.int64()),
+                    "attr": pa.array([f"a{i}" for i in range(G)])})
+    pq.write_table(fact, str(tmp_path / "fact.parquet"))
+    pq.write_table(dim, str(tmp_path / "dim.parquet"))
+    kernels._stage_cache.clear()
+    sql = """
+        select fk, sum(amount) as s, attr from dim, fact
+        where dk = fk group by fk, attr order by s desc limit 10
+    """
+    # unit level: the device stage builds, runs the top-k path, and DECLINES
+    # on the collapsed tie at the pool boundary instead of returning rows
+    from ballista_tpu.ops.factagg import FactAggregateStage
+    from ballista_tpu.ops.runtime import UnsupportedOnDevice
+    from ballista_tpu.physical.aggregate import HashAggregateExec
+    from ballista_tpu.physical.plan import TaskContext
+
+    ctx = _ctx("tpu", tmp_path)
+    cfg = ctx.config
+    phys = ctx.create_physical_plan(ctx.sql(sql).logical_plan())
+
+    def find_agg(n):
+        if isinstance(n, HashAggregateExec):
+            return n
+        for c in n.children():
+            r = find_agg(c)
+            if r is not None:
+                return r
+        return None
+
+    stage = FactAggregateStage(find_agg(phys))
+    assert stage.topk is not None
+    tctx = TaskContext(config=cfg, work_dir=str(tmp_path), job_id="t")
+    with pytest.raises(UnsupportedOnDevice, match="tie at candidate boundary"):
+        stage.run(0, tctx)
+
+    # end to end the decline lands on the host plan: values match exactly.
+    # The top-6 values are unique ints; equal-sum tail rows may tiebreak on
+    # any key, so compare the VALUE lists.
+    t = ctx.sql(sql).collect()
+    h = _ctx("host", tmp_path).sql(sql).collect()
+    assert t.column("s").to_pylist() == h.column("s").to_pylist()
+    assert (base + 1) in t.column("s").to_pylist()

@@ -240,6 +240,44 @@ def test_mesh_multi_column_key():
     )
 
 
+def test_mesh_skewed_run_lengths_unify_tile_width():
+    """One shard holds a single hot group (long runs -> large L1) while the
+    rest are high-cardinality (L1=8): shards must rebuild their layouts to
+    one shared tile width before stacking (the force_L1 branch)."""
+    rng = np.random.default_rng(13)
+    # first half: ONE mega-group (its shard sees a 0 percentile over the
+    # group grid -> L1=8); second half: every group 1..1100 at count 16
+    # (-> L1=16). The shards must agree on a tile width, so at least one
+    # rebuilds with force_L1.
+    G = 1100  # > 1024: the sorted mesh path
+    mega = np.zeros(G * 32, dtype=np.int64)
+    dense = np.tile(np.arange(1, G + 1, dtype=np.int64), 32)
+    keys = np.concatenate([mega, dense])
+    table = pa.table(
+        {
+            "k": pa.array(keys),
+            "v": pa.array(rng.uniform(0, 10, len(keys))),
+        }
+    )
+    spmd, out = _run_spmd(
+        table, ["k"],
+        [F.sum(col("v")).alias("s"), F.count(col("v")).alias("c")],
+        n_partitions=2,
+    )
+    assert spmd.last_path == "mesh"
+    ora = (
+        table.group_by("k").aggregate([("v", "sum"), ("v", "count")]).sort_by("k")
+    )
+    got = out.sort_by("k")
+    assert got.num_rows == ora.num_rows > 1024  # sorted mesh path
+    np.testing.assert_array_equal(
+        got.column("c").to_numpy(), ora.column("v_count").to_numpy()
+    )
+    np.testing.assert_allclose(
+        got.column("s").to_numpy(), ora.column("v_sum").to_numpy(), rtol=1e-4
+    )
+
+
 def test_mesh_fewer_partitions_than_devices():
     """Empty shards contribute the identity; results stay exact."""
     table = _sales(n=500, seed=5)

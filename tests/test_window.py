@@ -297,3 +297,50 @@ def test_range_frame_requires_one_order_key(ctx):
     with pytest.raises(BallistaError):
         c.sql("select sum(v) over (order by g, k range between 1 preceding "
               "and current row) as s from t")
+
+
+def test_short_partition_same_side_minmax_frame():
+    """A same-side min/max ROWS frame wider than the partition yields NULLs
+    (empty frames), not a crash (ADVICE r2: negative sliding-window width)."""
+    c = ExecutionContext()
+    t = pa.table({"v": pa.array([3.0, 1.0, 2.0])})
+    c.register_record_batches("t5", t)
+    out = c.sql(
+        "select v, min(v) over (order by v rows between 5 following "
+        "and 10 following) as mf, "
+        "max(v) over (order by v rows between 10 preceding "
+        "and 5 preceding) as mp from t5 order by v"
+    ).collect()
+    assert out.column("mf").to_pylist() == [None, None, None]
+    assert out.column("mp").to_pylist() == [None, None, None]
+    # partially-overlapping same-side frame still works
+    out = c.sql(
+        "select v, min(v) over (order by v rows between 1 following "
+        "and 10 following) as m from t5 order by v"
+    ).collect()
+    assert out.column("m").to_pylist() == [2.0, 3.0, None]
+
+
+def test_range_frame_null_order_keys():
+    """NULL order keys are one trailing peer group (standard semantics):
+    offset bounds resolve to the peer run, UNBOUNDED keeps the edge."""
+    c = ExecutionContext()
+    t = pa.table(
+        {
+            "k": pa.array([1.0, 2.0, None, 4.0, None]),
+            "v": pa.array([10.0, 20.0, 30.0, 40.0, 50.0]),
+        }
+    )
+    c.register_record_batches("t6", t)
+    out = c.sql(
+        "select k, v, sum(v) over (order by k range between 1 preceding "
+        "and current row) as rs, "
+        "sum(v) over (order by k range between unbounded preceding "
+        "and current row) as run from t6 order by k nulls last, v"
+    ).collect()
+    # sorted rows: k=1(v=10), k=2(v=20), k=4(v=40), NULL(v=30), NULL(v=50)
+    # rs: offset frame -> nulls see only the null peer group (30+50)
+    assert out.column("rs").to_pylist() == [10.0, 30.0, 40.0, 80.0, 80.0]
+    # running default (unbounded preceding .. current row incl peers):
+    # nulls include everything before plus their peer run
+    assert out.column("run").to_pylist() == [10.0, 30.0, 70.0, 150.0, 150.0]
