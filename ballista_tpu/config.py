@@ -38,6 +38,11 @@ BALLISTA_TPU_SPMD = "ballista.tpu.spmd_stages"
 # tiles, default) | "pallas" (MXU one-hot matmul with RMW DMA windows,
 # sum/count/avg only — measured slower on v5e, kept selectable)
 BALLISTA_TPU_SORTED_KERNEL = "ballista.tpu.sorted_kernel"
+# comma-separated directory allowlist for scan paths in plans arriving over
+# the wire ("" = unrestricted, the standalone/local default). The reference
+# executes any deserialized plan (rust/executor/src/flight_service.rs:90-192);
+# a rewrite should not let an unauthenticated peer scan arbitrary host files.
+BALLISTA_DATA_ROOTS = "ballista.executor.data_roots"
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -56,6 +61,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_FUSE_VOLATILE: "false",
     BALLISTA_TPU_SPMD: "false",
     BALLISTA_TPU_SORTED_KERNEL: "layout",
+    BALLISTA_DATA_ROOTS: "",
 }
 
 
@@ -125,6 +131,14 @@ class BallistaConfig(Mapping[str, str]):
         if k not in ("layout", "pallas"):
             raise ValueError(f"unknown sorted kernel {k!r} (layout|pallas)")
         return k
+
+    def data_roots(self):
+        """Directory allowlist for wire-plan scan paths; [] = unrestricted."""
+        return [
+            r.strip()
+            for r in self._settings[BALLISTA_DATA_ROOTS].split(",")
+            if r.strip()
+        ]
 
     def mesh_shape(self) -> Dict[str, int]:
         """Parse "data:4,model:2" into {"data": 4, "model": 2}."""

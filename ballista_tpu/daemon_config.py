@@ -35,6 +35,7 @@ EXECUTOR_SPEC: List[Tuple[str, Any, str]] = [
     ("work_dir", "", "shuffle work dir (default: temp dir)"),
     ("concurrent_tasks", 4, "max concurrent tasks"),
     ("backend", "cpu", "kernel backend: cpu | tpu"),
+    ("data_roots", "", "comma-separated dirs wire-plan scans may read ('' = any)"),
 ]
 
 

@@ -232,7 +232,7 @@ class ShuffleReaderExec(ExecutionPlan):
         self, loc: ShuffleLocation, piece_idx: int, ctx: TaskContext
     ) -> Iterator[pa.RecordBatch]:
         piece = os.path.join(loc.path, f"{piece_idx}.arrow")
-        if os.path.exists(piece):
+        if self._local_read_allowed(piece, ctx) and os.path.exists(piece):
             yield from read_ipc_file(piece)
         elif ctx.shuffle_fetcher is not None:
             yield from ctx.shuffle_fetcher(loc, piece_idx)
@@ -240,6 +240,23 @@ class ShuffleReaderExec(ExecutionPlan):
             raise ExecutionError(
                 f"shuffle piece not found locally and no fetcher: {piece}"
             )
+
+    @staticmethod
+    def _local_read_allowed(piece: str, ctx: TaskContext) -> bool:
+        """The local-disk shortcut is only for THIS task's own job directory.
+        A wire plan can carry arbitrary ShuffleLocation paths; reading them
+        from local disk would let a peer exfiltrate another job's shuffle
+        pieces (or any host .arrow file) — those go through the Flight
+        fetcher instead, where the OWNING executor confines the path to its
+        work_dir. A trusted in-process context (no work_dir, no fetcher)
+        keeps the direct read."""
+        if ctx.work_dir is None:
+            return ctx.shuffle_fetcher is None
+        root = os.path.realpath(
+            os.path.join(ctx.work_dir, ctx.job_id) if ctx.job_id else ctx.work_dir
+        )
+        p = os.path.realpath(piece)
+        return os.path.commonpath([root, p]) == root
 
     def fmt(self) -> str:
         return f"ShuffleReaderExec: partitions={self.num_partitions}, maps={len(self.locations)}"

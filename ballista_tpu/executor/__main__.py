@@ -44,7 +44,12 @@ def main() -> None:
         port=cfg["port"],
         work_dir=cfg["work_dir"] or None,
         concurrent_tasks=cfg["concurrent_tasks"],
-        config=BallistaConfig({"ballista.executor.backend": cfg["backend"]}),
+        config=BallistaConfig(
+            {
+                "ballista.executor.backend": cfg["backend"],
+                "ballista.executor.data_roots": cfg["data_roots"],
+            }
+        ),
     )
     executor.start()
     log.info(

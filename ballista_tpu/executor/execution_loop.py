@@ -138,7 +138,19 @@ class PollLoop:
         status = pb.TaskStatus()
         status.partition_id.CopyFrom(pid)
         try:
+            # allowlist comes from the EXECUTOR's own config; the per-job
+            # settings merged below are client-controlled and must not
+            # widen it. Proto check first: deserializing a parquet source
+            # already reads the file footer.
+            from ballista_tpu.executor.confine import (
+                check_proto_scan_roots,
+                check_scan_roots,
+            )
+
+            roots = self.config.data_roots()
+            check_proto_scan_roots(task.plan, roots)
             plan = phys_plan_from_proto(task.plan)
+            check_scan_roots(plan, roots)
             if not isinstance(plan, ShuffleWriterExec):
                 plan = ShuffleWriterExec(pid.job_id, pid.stage_id, plan, None)
             cfg = self.config

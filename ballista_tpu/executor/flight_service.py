@@ -80,7 +80,19 @@ class BallistaFlightService(flight.FlightServerBase):
         # unauthenticated peer must not steer writes outside work_dir
         if not _JOB_ID_RE.fullmatch(req.job_id):
             raise flight.FlightServerError(f"invalid job id {req.job_id!r}")
+        # allowlist comes from the EXECUTOR's own config; per-job client
+        # settings (attacker-controlled) must not widen it. The proto-level
+        # check runs BEFORE deserialization (which already opens parquet
+        # footers); the plan-level check covers resolved files.
+        from ballista_tpu.executor.confine import (
+            check_proto_scan_roots,
+            check_scan_roots,
+        )
+
+        roots = self.config.data_roots()
+        check_proto_scan_roots(req.plan, roots)
         plan = phys_plan_from_proto(req.plan)
+        check_scan_roots(plan, roots)
         cfg = BallistaConfig({**self.config.to_dict(), **{kv.key: kv.value for kv in settings}})
         ctx = TaskContext(config=cfg, work_dir=self.work_dir, job_id=req.job_id,
                           shuffle_fetcher=flight_shuffle_fetcher)

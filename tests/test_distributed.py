@@ -148,3 +148,39 @@ def test_distributed_matches_local(ctx, sales_table):
     d = ctx.sql(q).collect().to_pylist()
     l = local.sql(q).collect().to_pylist()
     assert d == l
+
+
+def test_poll_loop_enforces_data_roots(tmp_path):
+    """The pull-based task path applies the executor's scan-path allowlist:
+    a job scanning outside the configured roots fails instead of reading."""
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.errors import ExecutionError
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    allowed = tmp_path / "data"
+    allowed.mkdir()
+    pq.write_table(pa.table({"x": [1.0, 2.0, 3.0]}), str(allowed / "t.parquet"))
+    outside = tmp_path / "secret.parquet"
+    pq.write_table(pa.table({"x": [9.0]}), str(outside))
+
+    cluster = StandaloneCluster(
+        n_executors=1,
+        config=BallistaConfig(
+            {"ballista.executor.data_roots": str(allowed)}
+        ),
+    )
+    try:
+        host, port = cluster.scheduler_addr
+        c = BallistaContext(host, port)
+        c.register_parquet("ok", str(allowed / "t.parquet"))
+        c.register_parquet("bad", str(outside))
+        out = c.sql("select sum(x) as s from ok").collect()
+        assert out.column("s").to_pylist() == [6.0]
+        with pytest.raises(ExecutionError, match="failed"):
+            c.sql("select sum(x) as s from bad").collect()
+        c.close()
+    finally:
+        cluster.shutdown()

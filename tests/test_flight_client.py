@@ -127,3 +127,126 @@ def test_fetch_streams_multibatch_partition(tmp_path):
     finally:
         client.close()
         svc.shutdown()
+
+
+def test_execute_partition_scan_root_allowlist(tmp_path):
+    """With data_roots configured, a wire plan scanning a file outside the
+    allowlist is refused (the reference executes any deserialized plan —
+    rust/executor/src/flight_service.rs:90-192; this rewrite does not).
+    A scan under the root still executes."""
+    import socket
+    import threading
+
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.executor.flight_service import BallistaFlightService
+    from ballista_tpu.logical import col, functions as F
+
+    allowed = tmp_path / "data"
+    allowed.mkdir()
+    pq.write_table(pa.table({"x": [1.0, 2.0]}), str(allowed / "ok.parquet"))
+    outside = tmp_path / "secret.parquet"
+    pq.write_table(pa.table({"x": [9.0]}), str(outside))
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    svc = BallistaFlightService(
+        f"grpc://0.0.0.0:{port}",
+        str(tmp_path / "work"),
+        BallistaConfig({"ballista.executor.data_roots": str(allowed)}),
+    )
+    threading.Thread(target=svc.serve, daemon=True).start()
+
+    def plan_for(path):
+        ctx = ExecutionContext()
+        ctx.register_parquet("t", str(path))
+        df = ctx.table("t").aggregate([], [F.sum(col("x")).alias("s")])
+        return ctx.create_physical_plan(df.logical_plan())
+
+    client = BallistaClient("127.0.0.1", port)
+    # inside the allowlist: fine
+    results = client.execute_partition("joba", 1, [0], plan_for(allowed / "ok.parquet"))
+    assert len(results) == 1
+    # outside (e.g. /etc/passwd-shaped exfiltration): refused
+    with pytest.raises(Exception, match="outside configured data roots"):
+        client.execute_partition("jobb", 1, [0], plan_for(outside))
+    # client-supplied per-job settings must NOT widen the allowlist
+    with pytest.raises(Exception, match="outside configured data roots"):
+        client.execute_partition(
+            "jobc", 1, [0], plan_for(outside),
+            settings={"ballista.executor.data_roots": ""},
+        )
+    client.close()
+    svc.shutdown()
+
+
+def test_scan_allowlist_refuses_before_deserialization(tmp_path, monkeypatch):
+    """The refusal must happen on the RAW proto: constructing a parquet
+    source already reads the file footer, which would hand the peer an
+    existence/readability oracle for host paths."""
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.errors import PlanError
+    from ballista_tpu.executor import confine
+    from ballista_tpu.proto import ballista_pb2 as pb
+    import ballista_tpu.serde.logical as slog
+
+    touched = []
+    orig = slog.source_from_proto
+
+    def spy(d):
+        touched.append(d.path)
+        return orig(d)
+
+    monkeypatch.setattr(slog, "source_from_proto", spy)
+    n = pb.PhysicalPlanNode()
+    n.scan.scan.source.table_type = "parquet"
+    n.scan.scan.source.path = "/etc/passwd"
+    with pytest.raises(PlanError, match="outside configured data roots"):
+        confine.check_proto_scan_roots(n, [str(tmp_path)])
+    assert not touched  # nothing was deserialized, no disk I/O happened
+
+
+def test_shuffle_reader_local_shortcut_confined_to_own_job(tmp_path):
+    """A wire plan naming another job's shuffle directory must not read it
+    from local disk; out-of-job locations go through the Flight fetcher
+    (which the owning executor confines)."""
+    import pyarrow.ipc as ipc
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.distributed.stages import ShuffleLocation, ShuffleReaderExec
+    from ballista_tpu.physical.plan import TaskContext
+
+    schema = pa.schema([pa.field("x", pa.int64())])
+    other = tmp_path / "work" / "otherjob" / "1" / "0"
+    other.mkdir(parents=True)
+    with ipc.new_file(str(other / "0.arrow"), schema) as w:
+        w.write_batch(pa.record_batch([pa.array([42])], schema=schema))
+
+    reader = ShuffleReaderExec(
+        [ShuffleLocation("e1", "127.0.0.1", 1, str(other))], schema, 1
+    )
+    fetched = []
+
+    def fetcher(loc, piece):
+        fetched.append((loc.path, piece))
+        return iter(())
+
+    # same work_dir, DIFFERENT job: local read refused, fetcher used
+    ctx = TaskContext(config=BallistaConfig(), work_dir=str(tmp_path / "work"),
+                      job_id="myjob", shuffle_fetcher=fetcher)
+    assert list(reader.execute(0, ctx)) == []
+    assert fetched == [(str(other), 0)]
+
+    # the task's own job directory keeps the local shortcut
+    mine = tmp_path / "work" / "myjob" / "1" / "0"
+    mine.mkdir(parents=True)
+    with ipc.new_file(str(mine / "0.arrow"), schema) as w:
+        w.write_batch(pa.record_batch([pa.array([7])], schema=schema))
+    reader2 = ShuffleReaderExec(
+        [ShuffleLocation("e1", "127.0.0.1", 1, str(mine))], schema, 1
+    )
+    out = list(reader2.execute(0, ctx))
+    assert out and out[0].column(0).to_pylist() == [7]
