@@ -22,6 +22,7 @@ SCHEDULER_SPEC: List[Tuple[str, Any, str]] = [
     ("etcd_urls", "localhost:2379", "etcd endpoints (etcd backend)"),
     ("bind_host", "0.0.0.0", "bind address"),
     ("port", 50050, "grpc port"),
+    ("data_roots", "", "comma-separated dirs wire-plan scans may read ('' = any)"),
 ]
 
 EXECUTOR_SPEC: List[Tuple[str, Any, str]] = [

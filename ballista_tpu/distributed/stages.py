@@ -250,13 +250,14 @@ class ShuffleReaderExec(ExecutionPlan):
         fetcher instead, where the OWNING executor confines the path to its
         work_dir. A trusted in-process context (no work_dir, no fetcher)
         keeps the direct read."""
+        from ballista_tpu.executor.confine import contained
+
         if ctx.work_dir is None:
             return ctx.shuffle_fetcher is None
-        root = os.path.realpath(
+        root = (
             os.path.join(ctx.work_dir, ctx.job_id) if ctx.job_id else ctx.work_dir
         )
-        p = os.path.realpath(piece)
-        return os.path.commonpath([root, p]) == root
+        return contained(piece, root)
 
     def fmt(self) -> str:
         return f"ShuffleReaderExec: partitions={self.num_partitions}, maps={len(self.locations)}"

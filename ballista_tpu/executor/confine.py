@@ -28,6 +28,23 @@ from ballista_tpu.errors import PlanError
 def _real_roots(roots: List[str]) -> List[str]:
     return [os.path.realpath(r) for r in roots]
 
+
+def resolve_contained(path: str, root: str):
+    """The single containment primitive for every trust boundary here — the
+    Flight work_dir check and the shuffle local-read check use it too, so a
+    hardening fix lands everywhere at once. Returns the RESOLVED path when
+    it lies inside root (symlinks followed), else None — callers must use
+    the returned string, never re-resolve (a second realpath of a swapped
+    symlink could escape the check)."""
+    p = os.path.realpath(path)
+    r = os.path.realpath(root)
+    return p if os.path.commonpath([r, p]) == r else None
+
+
+def contained(path: str, root: str) -> bool:
+    return resolve_contained(path, root) is not None
+
+
 def _under(path: str, real_roots: List[str]) -> bool:
     p = os.path.realpath(path)
     return any(os.path.commonpath([root, p]) == root for root in real_roots)
@@ -52,12 +69,37 @@ def check_proto_scan_roots(plan_proto, roots: List[str]) -> None:
     real = _real_roots(roots)
     for node in _walk_messages(plan_proto):
         if isinstance(node, pb.TableSourceDesc):
-            if node.table_type in ("csv", "parquet") and node.path:
+            # fail CLOSED: anything that is not the in-memory type is
+            # treated as file-backed, so a future disk-backed table type
+            # cannot silently bypass the check
+            if node.table_type != "memory" and node.path:
                 if not _under(node.path, real):
                     raise PlanError(
                         "scan path outside configured data roots refused: "
                         f"{node.path!r}"
                     )
+
+
+def check_scan_roots_path(path: str, roots: List[str]) -> None:
+    """Single-path form, for CREATE EXTERNAL TABLE locations and
+    GetFileMetadata requests."""
+    if roots and not _under(path, _real_roots(roots)):
+        raise PlanError(
+            f"scan path outside configured data roots refused: {path!r}"
+        )
+
+
+def check_scan_files(files, roots: List[str]) -> None:
+    """Resolved-file-list form: discovery follows symlinks, so the files a
+    source actually resolved to are re-checked against the roots."""
+    if not roots:
+        return
+    real = _real_roots(roots)
+    for f in files:
+        if not _under(f, real):
+            raise PlanError(
+                f"scan path outside configured data roots refused: {f!r}"
+            )
 
 
 def check_scan_roots(plan, roots: List[str]) -> None:
@@ -68,17 +110,12 @@ def check_scan_roots(plan, roots: List[str]) -> None:
     """
     if not roots:
         return
-    real = _real_roots(roots)
 
     def walk(node):
         src = getattr(node, "source", None)
         files = getattr(src, "files", None)
         if files:
-            for f in files:
-                if not _under(f, real):
-                    raise PlanError(
-                        f"scan path outside configured data roots refused: {f!r}"
-                    )
+            check_scan_files(files, roots)
         for c in node.children():
             walk(c)
         # stage wrappers that deliberately hide their subtree from planner

@@ -64,13 +64,14 @@ class BallistaFlightService(flight.FlightServerBase):
         """Confine ticket paths to this executor's work_dir. The ticket comes
         from an unauthenticated peer; without this check FetchPartition would
         serve any readable file on the host (ADVICE r1, high)."""
-        root = os.path.realpath(self.work_dir)
-        path = os.path.realpath(raw)
-        if os.path.commonpath([root, path]) != root:
+        from ballista_tpu.executor.confine import resolve_contained
+
+        resolved = resolve_contained(raw, self.work_dir)
+        if resolved is None:
             raise flight.FlightServerError(
                 f"path outside work_dir refused: {raw!r}"
             )
-        return path
+        return resolved
 
     def _execute_partition(self, req: pb.ExecutePartition, settings) -> flight.RecordBatchStream:
         from ballista_tpu.serde.physical import phys_plan_from_proto

@@ -31,7 +31,15 @@ def main() -> None:
         kv = SqliteBackend(cfg["sqlite_path"])
     else:
         kv = MemoryBackend()
-    impl = SchedulerServer(kv, namespace=cfg["namespace"])
+    from ballista_tpu.config import BallistaConfig
+
+    impl = SchedulerServer(
+        kv,
+        namespace=cfg["namespace"],
+        config=BallistaConfig(
+            {"ballista.executor.data_roots": cfg["data_roots"]}
+        ),
+    )
     server = serve(impl, cfg["bind_host"], cfg["port"])
     logging.getLogger("ballista.scheduler").info(
         "Ballista-TPU scheduler up (backend=%s, namespace=%s, port=%s)",

@@ -70,20 +70,29 @@ class SchedulerGrpcClient:
                 response_deserializer=resp_cls.FromString,
             )
 
+    def _call(self, name: str, params):
+        from ballista_tpu.errors import RpcError
+
+        try:
+            return self._stubs[name](params)
+        except grpc.RpcError as e:
+            detail = e.details() if hasattr(e, "details") else str(e)
+            raise RpcError(f"{name} failed: {detail}") from e
+
     def execute_query(self, params: pb.ExecuteQueryParams) -> pb.ExecuteQueryResult:
-        return self._stubs["ExecuteQuery"](params)
+        return self._call("ExecuteQuery", params)
 
     def poll_work(self, params: pb.PollWorkParams) -> pb.PollWorkResult:
-        return self._stubs["PollWork"](params)
+        return self._call("PollWork", params)
 
     def get_job_status(self, params: pb.GetJobStatusParams) -> pb.GetJobStatusResult:
-        return self._stubs["GetJobStatus"](params)
+        return self._call("GetJobStatus", params)
 
     def get_executors_metadata(self) -> pb.GetExecutorMetadataResult:
-        return self._stubs["GetExecutorsMetadata"](pb.GetExecutorMetadataParams())
+        return self._call("GetExecutorsMetadata", pb.GetExecutorMetadataParams())
 
     def get_file_metadata(self, params: pb.GetFileMetadataParams) -> pb.GetFileMetadataResult:
-        return self._stubs["GetFileMetadata"](params)
+        return self._call("GetFileMetadata", params)
 
     def close(self) -> None:
         self.channel.close()

@@ -66,18 +66,40 @@ class SchedulerServer:
 
     # -- RPC implementations ------------------------------------------------
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None) -> pb.ExecuteQueryResult:
+        from ballista_tpu.executor.confine import (
+            check_proto_scan_roots,
+            check_scan_files,
+            check_scan_roots,
+            check_scan_roots_path,
+        )
+
         which = request.WhichOneof("query")
         settings = {kv.key: kv.value for kv in request.settings}
         config = BallistaConfig({**self.config.to_dict(), **settings})
+        # data-root allowlist from the SCHEDULER's own config (client
+        # settings must not widen it). Two layers, like the executor entry
+        # points: the raw proto before any table source construction touches
+        # disk, then the constructed plan's RESOLVED file lists (discovery
+        # follows directory symlinks).
+        roots = self.config.data_roots()
         if which == "logical_plan":
+            check_proto_scan_roots(request.logical_plan, roots)
             plan = plan_from_proto(request.logical_plan)
+            check_scan_roots(plan, roots)
         elif which == "sql":
             from ballista_tpu.logical import plan as lp
             from ballista_tpu.sql.planner import plan_sql
 
             plan = plan_sql(request.sql, self.catalog)
             if isinstance(plan, lp.CreateExternalTable):
+                check_scan_roots_path(plan.location, roots)
                 self.catalog._create_external_table(plan)
+                src = self.catalog.tables.get(plan.name.lower())
+                try:
+                    check_scan_files(getattr(src, "files", []) or [], roots)
+                except Exception:
+                    self.catalog.tables.pop(plan.name.lower(), None)
+                    raise
                 return pb.ExecuteQueryResult(job_id="")
         else:
             raise ValueError("ExecuteQueryParams requires a plan or sql")
@@ -175,8 +197,16 @@ class SchedulerServer:
         if request.file_type.lower() != "parquet":
             raise ValueError("GetFileMetadata supports parquet only")
         from ballista_tpu.datasource import ParquetTableSource
+        from ballista_tpu.executor.confine import (
+            check_scan_files,
+            check_scan_roots_path,
+        )
 
+        # same allowlist as ExecuteQuery: this RPC reads parquet footers of
+        # client-named host paths
+        check_scan_roots_path(request.path, self.config.data_roots())
         src = ParquetTableSource(request.path)
+        check_scan_files(src.files, self.config.data_roots())
         return pb.GetFileMetadataResult(
             schema_ipc=schema_to_ipc(src.schema()),
             num_partitions=src.num_partitions(),

@@ -151,14 +151,17 @@ def test_distributed_matches_local(ctx, sales_table):
 
 
 def test_poll_loop_enforces_data_roots(tmp_path):
-    """The pull-based task path applies the executor's scan-path allowlist:
-    a job scanning outside the configured roots fails instead of reading."""
+    """The pull-based task path applies the EXECUTOR's scan-path allowlist
+    even when the scheduler is unrestricted: the task fails on the executor
+    instead of reading the file."""
     import pyarrow.parquet as pq
 
     from ballista_tpu.client import BallistaContext
     from ballista_tpu.config import BallistaConfig
     from ballista_tpu.errors import ExecutionError
-    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.executor.runtime import BallistaExecutor, _free_port
+    from ballista_tpu.scheduler.kv import MemoryBackend
+    from ballista_tpu.scheduler.server import SchedulerServer, serve
 
     allowed = tmp_path / "data"
     allowed.mkdir()
@@ -166,21 +169,72 @@ def test_poll_loop_enforces_data_roots(tmp_path):
     outside = tmp_path / "secret.parquet"
     pq.write_table(pa.table({"x": [9.0]}), str(outside))
 
-    cluster = StandaloneCluster(
-        n_executors=1,
-        config=BallistaConfig(
-            {"ballista.executor.data_roots": str(allowed)}
-        ),
+    # scheduler: no allowlist; executor: confined to `allowed`
+    impl = SchedulerServer(MemoryBackend())
+    port = _free_port()
+    server = serve(impl, "127.0.0.1", port)
+    ex = BallistaExecutor(
+        "127.0.0.1", port,
+        config=BallistaConfig({"ballista.executor.data_roots": str(allowed)}),
     )
+    ex.start()
     try:
-        host, port = cluster.scheduler_addr
-        c = BallistaContext(host, port)
+        c = BallistaContext("127.0.0.1", port)
         c.register_parquet("ok", str(allowed / "t.parquet"))
         c.register_parquet("bad", str(outside))
         out = c.sql("select sum(x) as s from ok").collect()
         assert out.column("s").to_pylist() == [6.0]
         with pytest.raises(ExecutionError, match="failed"):
             c.sql("select sum(x) as s from bad").collect()
+        c.close()
+    finally:
+        ex.stop()
+        server.stop(grace=None)
+
+
+def test_scheduler_enforces_data_roots(tmp_path):
+    """ExecuteQuery deserializes client plans on the scheduler host; the
+    scheduler's own data-root allowlist refuses out-of-root scans before
+    any table source touches disk, and CREATE EXTERNAL TABLE likewise."""
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.errors import BallistaError
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    allowed = tmp_path / "data"
+    allowed.mkdir()
+    pq.write_table(pa.table({"x": [1.0, 2.0]}), str(allowed / "t.parquet"))
+    outside = tmp_path / "secret.parquet"
+    pq.write_table(pa.table({"x": [9.0]}), str(outside))
+
+    cluster = StandaloneCluster(
+        n_executors=1,
+        config=BallistaConfig({"ballista.executor.data_roots": str(allowed)}),
+    )
+    try:
+        host, port = cluster.scheduler_addr
+        c = BallistaContext(host, port)
+        c.register_parquet("ok", str(allowed / "t.parquet"))
+        assert c.sql("select sum(x) as s from ok").collect().column("s").to_pylist() == [3.0]
+        c.register_parquet("bad", str(outside))
+        with pytest.raises(BallistaError, match="data roots|failed"):
+            c.sql("select sum(x) as s from bad").collect()
+        # raw-SQL RPC path: CREATE EXTERNAL TABLE outside the roots refused
+        # on the scheduler host before any footer read
+        from ballista_tpu.proto import ballista_pb2 as pb
+        from ballista_tpu.scheduler.rpc import SchedulerGrpcClient
+
+        rpc = SchedulerGrpcClient(host, port)
+        with pytest.raises(BallistaError, match="data roots"):
+            rpc.execute_query(
+                pb.ExecuteQueryParams(
+                    sql="create external table evil stored as parquet "
+                    f"location '{outside}'"
+                )
+            )
+        rpc.close()
         c.close()
     finally:
         cluster.shutdown()
