@@ -232,8 +232,9 @@ class ShuffleReaderExec(ExecutionPlan):
         self, loc: ShuffleLocation, piece_idx: int, ctx: TaskContext
     ) -> Iterator[pa.RecordBatch]:
         piece = os.path.join(loc.path, f"{piece_idx}.arrow")
-        if self._local_read_allowed(piece, ctx) and os.path.exists(piece):
-            yield from read_ipc_file(piece)
+        resolved = self._local_read_path(piece, ctx)
+        if resolved is not None and os.path.exists(resolved):
+            yield from read_ipc_file(resolved)
         elif ctx.shuffle_fetcher is not None:
             yield from ctx.shuffle_fetcher(loc, piece_idx)
         else:
@@ -242,22 +243,25 @@ class ShuffleReaderExec(ExecutionPlan):
             )
 
     @staticmethod
-    def _local_read_allowed(piece: str, ctx: TaskContext) -> bool:
-        """The local-disk shortcut is only for THIS task's own job directory.
-        A wire plan can carry arbitrary ShuffleLocation paths; reading them
-        from local disk would let a peer exfiltrate another job's shuffle
-        pieces (or any host .arrow file) — those go through the Flight
-        fetcher instead, where the OWNING executor confines the path to its
-        work_dir. A trusted in-process context (no work_dir, no fetcher)
-        keeps the direct read."""
-        from ballista_tpu.executor.confine import contained
+    def _local_read_path(piece: str, ctx: TaskContext):
+        """Resolved path for the local-disk shortcut, or None to use the
+        Flight fetcher. The shortcut is only for THIS task's own job
+        directory: a wire plan can carry arbitrary ShuffleLocation paths,
+        and reading them from local disk would let a peer exfiltrate
+        another job's shuffle pieces (or any host .arrow file) — those go
+        through the fetcher instead, where the OWNING executor confines the
+        path to its work_dir. The RESOLVED path is returned and opened (not
+        the raw one), so a symlink swapped after the check cannot escape.
+        A trusted in-process context (no work_dir, no fetcher) keeps the
+        direct read."""
+        from ballista_tpu.executor.confine import resolve_contained
 
         if ctx.work_dir is None:
-            return ctx.shuffle_fetcher is None
+            return piece if ctx.shuffle_fetcher is None else None
         root = (
             os.path.join(ctx.work_dir, ctx.job_id) if ctx.job_id else ctx.work_dir
         )
-        return contained(piece, root)
+        return resolve_contained(piece, root)
 
     def fmt(self) -> str:
         return f"ShuffleReaderExec: partitions={self.num_partitions}, maps={len(self.locations)}"

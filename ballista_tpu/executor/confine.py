@@ -46,8 +46,7 @@ def contained(path: str, root: str) -> bool:
 
 
 def _under(path: str, real_roots: List[str]) -> bool:
-    p = os.path.realpath(path)
-    return any(os.path.commonpath([root, p]) == root for root in real_roots)
+    return any(resolve_contained(path, root) is not None for root in real_roots)
 
 
 def _walk_messages(msg) -> Iterator:

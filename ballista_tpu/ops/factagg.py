@@ -24,17 +24,23 @@ TPU-first redesign (eager-aggregation + semi-join membership):
             and Limit operators above run unchanged on K rows.
 
 Pattern matched: HashAggregateExec[single|partial] over
- [Filter/Projection/Coalesce]* -> an INNER hash-join tree in which the
-largest file-backed scan chain (the fact) sits anywhere reachable through
-inner joins — directly (q3: orders x lineitem) or nested (q10:
-((customer x orders) x lineitem) x nation). The fact's own join must be a
-single equi-key with no residual filter; joins between it and the root must
-not be keyed on fact columns (q5 joins supplier on l_suppkey — host path).
-Fact-side group keys must be the join key; dim-side group keys are attached
-post-aggregation; all aggregate inputs must be fact-side expressions. The
-device top-k epilogue additionally requires the fact key among the group
-keys (one output group per key); dim-only grouping (q10) uses the
-member-select readback and the ordinary final merge re-groups.
+ [Filter/Projection/Coalesce]* -> a hash-join tree in which the largest
+file-backed scan chain (the fact) sits anywhere reachable through INNER
+joins and the LEFT side of SEMI/ANTI joins — directly (q3: orders x
+lineitem), nested (q10: ((customer x orders) x lineitem) x nation), or
+under a semi filter (q18: the "orderkey IN (big orders)" build side folds
+whole into the dim-plan membership). The fact's own join must be INNER,
+single equi-key, no residual filter. Joins between it and the root are
+normally host-side over the dim plan and must not be keyed on fact columns
+— with ONE exception: a coupled secondary dim (q5: supplier joined on
+l_suppkey with c_nationkey = s_nationkey coupling) runs per-S_ATTR-class
+on device via a static mapped column (_detect_secondary). Fact-side group
+keys must be the join key; dim-side group keys are attached
+post-aggregation (secondary mode: group keys attach per class); all
+aggregate inputs must be fact-side expressions. The device top-k epilogue
+additionally requires the fact key among the group keys (one output group
+per key); dim-only grouping (q10) uses the member-select readback and the
+ordinary final merge re-groups.
 """
 
 from __future__ import annotations
@@ -128,20 +134,30 @@ class FactAggregateStage:
             elif isinstance(node, ProjectionExec):
                 stack.append(("project", node.exprs))
             node = node.input
-        if not isinstance(node, HashJoinExec) or node.join_type != JoinType.INNER:
-            raise UnsupportedOnDevice("row source is not an inner hash join")
+        _WALKABLE = (JoinType.INNER, JoinType.SEMI, JoinType.ANTI)
+        if not isinstance(node, HashJoinExec) or node.join_type not in _WALKABLE:
+            raise UnsupportedOnDevice("row source is not a foldable hash join")
+        if node.filter is not None:
+            # a residual filter is an index-based expr over concat(left,
+            # right); rebuilding the dim plan with the fact block removed
+            # would silently shift what it reads
+            raise UnsupportedOnDevice("root join has a residual filter")
         root = node
 
-        # -- locate the fact scan chain anywhere in the inner-join tree --
-        # Paths may only cross INNER HashJoinExec nodes (their output schema
-        # is the concatenation of their children, so removing the fact block
-        # keeps every other column's relative order); the fact is the
-        # largest file-backed scan chain reachable that way (q10 nests
-        # lineitem two joins deep).
+        # -- locate the fact scan chain anywhere in the join tree -------
+        # Paths may cross INNER HashJoinExec nodes (their output schema is
+        # the concatenation of their children, so removing the fact block
+        # keeps every other column's relative order) and the LEFT side of
+        # SEMI/ANTI joins (their output schema IS the left schema; the
+        # filtering build side stays whole inside the dim plan — q18's
+        # "orderkey IN (big-quantity orders)" folds into the membership
+        # this way). The fact is the largest file-backed scan chain
+        # reachable that way (q10 nests lineitem two joins deep).
         candidates: List[Tuple[list, HashJoinExec, str, int]] = []
 
         def dfs(j, path):
-            for side in ("left", "right"):
+            sides = ("left",) if j.join_type != JoinType.INNER else ("left", "right")
+            for side in sides:
                 child = getattr(j, side)
                 leaf = _scan_chain_leaf(child)
                 if leaf is not None:
@@ -150,7 +166,7 @@ class FactAggregateStage:
                         candidates.append((list(path), j, side, b))
                 elif (
                     isinstance(child, HashJoinExec)
-                    and child.join_type == JoinType.INNER
+                    and child.join_type in _WALKABLE
                     and child.filter is None
                 ):
                     dfs(child, path + [(j, side)])
@@ -159,18 +175,27 @@ class FactAggregateStage:
         if not candidates:
             raise UnsupportedOnDevice("no file-backed scan side")
         path, join, fact_side, _ = max(candidates, key=lambda c: c[3])
+        if join.join_type != JoinType.INNER:
+            # aggregates distribute over the fact's own join only when it
+            # attaches at most one dim row per fact row (INNER + unique key)
+            raise UnsupportedOnDevice("fact join is not inner")
         if join.filter is not None or len(join.on) != 1:
             raise UnsupportedOnDevice("fact join shape (residual filter / multi-key)")
         self.fact_plan = getattr(join, fact_side)
         fact_n = len(self.fact_plan.schema())
-        # joins between the root and the fact join run on the host over the
-        # dim plan; they must not need fact columns (q5 joins supplier on
-        # l_suppkey — that shape stays on the host path)
+        # joins between the root and the fact join normally run on the host
+        # over the dim plan, so they must not need fact columns. ONE shape
+        # of fact-column-keyed upper join is supported: the coupled
+        # secondary dim (q5 joins supplier on l_suppkey, coupled through
+        # c_nationkey = s_nationkey) — see _detect_secondary.
         fact_names = set(self.fact_plan.schema().names)
-        for j, _side in path:
-            for ln, rn in j.on:
-                if ln in fact_names or rn in fact_names:
-                    raise UnsupportedOnDevice("upper join keyed on a fact column")
+        offending = [
+            i for i, (j, _side) in enumerate(path)
+            if any(ln in fact_names or rn in fact_names for ln, rn in j.on)
+        ]
+        self.secondary: Optional[dict] = None
+        if offending:
+            self._detect_secondary(path, offending, join, fact_side, fact_names)
         # offset of the fact block within the root's flattened schema
         fact_offset = 0
         for j, side in path + [(join, fact_side)]:
@@ -182,11 +207,15 @@ class FactAggregateStage:
         fact_key_idx = self.fact_plan.schema().names.index(self.fact_key)
 
         # -- dim plan: the join tree with the fact subtree removed ------
+        # In secondary mode every path join belongs to the SECONDARY plan
+        # (built in _detect_secondary); the primary dim plan is just the
+        # fact join's other side.
         replacement = join.left if fact_side == "right" else join.right
-        for j, side in reversed(path):
-            children = [j.left, j.right]
-            children[0 if side == "left" else 1] = replacement
-            replacement = j.with_children(children)
+        if self.secondary is None:
+            for j, side in reversed(path):
+                children = [j.left, j.right]
+                children[0 if side == "left" else 1] = replacement
+                replacement = j.with_children(children)
         self.dim_plan = replacement
 
         # -- re-express aggregate exprs over the root join schema -------
@@ -223,10 +252,24 @@ class FactAggregateStage:
             return substitute_columns(e, fact_map)
 
         # group keys: the fact side may contribute only the join key; dim
-        # keys become post-aggregation attachments
+        # keys become post-aggregation attachments. Secondary mode instead
+        # requires every group key to be a secondary-plan column (q5 groups
+        # by n_name): values attach per allowed S_ATTR class.
         self.group_layout: List[Tuple[str, Optional[str]]] = []
+        sec_group_cols: List[Tuple[str, str]] = []
         for e, name in [(substitute_columns(e, mapping), n) for e, n in agg.group_exprs]:
             s = side_of(e)
+            if self.secondary is not None:
+                if not (
+                    isinstance(e, px.ColumnExpr)
+                    and e.index >= self.secondary["sec_start"]
+                    and e.name in self.secondary["plan"].schema().names
+                ):
+                    raise UnsupportedOnDevice(
+                        "secondary mode requires secondary-side group keys"
+                    )
+                sec_group_cols.append((e.name, name))
+                continue
             if s == "fact":
                 if not (isinstance(e, px.ColumnExpr) and e.index - fact_offset == fact_key_idx):
                     raise UnsupportedOnDevice("fact-side group key is not the join key")
@@ -239,6 +282,8 @@ class FactAggregateStage:
                 self.group_layout.append((dim_name, name))
             else:
                 raise UnsupportedOnDevice("unsupported group key shape")
+        if self.secondary is not None:
+            self.secondary["group_cols"] = sec_group_cols
 
         fact_filters = []
         for f in above_filters:
@@ -274,6 +319,24 @@ class FactAggregateStage:
         self.inner.sorted_cover_max = True
         if not self.inner.cacheable:
             raise UnsupportedOnDevice("fact side not cacheable")
+        if self.secondary is not None:
+            # F2 (the secondary fact key, e.g. l_suppkey) as a SCAN-space
+            # column: compiling it registers it with the column loader, and
+            # the derived-column hook materializes the static mapped S_ATTR
+            # per row alongside the resident tiles
+            sec = self.secondary
+            f2_fact_idx = self.fact_plan.schema().names.index(sec["f2"])
+            f2_scan = substitute_columns(
+                px.ColumnExpr(sec["f2"], f2_fact_idx), self.inner.input_to_scan
+            )
+            if not isinstance(f2_scan, px.ColumnExpr):
+                raise UnsupportedOnDevice("secondary fact key is not a column")
+            cv = self.inner.compiler.compile(f2_scan)
+            if cv.kind == "code":
+                raise UnsupportedOnDevice("string secondary fact key")
+            sec["f2_scan_idx"] = f2_scan.index
+            self._sec_map = None  # (sorted base S_KEYs, their S_ATTRs)
+            self.inner.derive_columns["sec_attr"] = self._derive_sec_attr
         self.partial_schema = FusedAggregateStage._partial_schema(agg)
         # planner-provided Sort+Limit epilogue (physical/planner.py)
         self.topk = getattr(agg, "_topk_pushdown", None)
@@ -295,6 +358,279 @@ class FactAggregateStage:
         self._dim_cache: Optional[dict] = None
         self._prepared: Dict[int, dict] = {}
         self._fact_step = None
+        self._sec_cache: Optional[dict] = None
+        self._sec_step = None
+        if self.secondary is not None and any(self.inner.int_exact):
+            # secondary-mode reductions span the whole partition in one
+            # jnp.sum; int32 accumulation could overflow silently
+            raise UnsupportedOnDevice("int-exact aggregate in secondary mode")
+
+    # ------------------------------------------------------------------
+    def _detect_secondary(self, path, offending, join, fact_side, fact_names):
+        """q5 shape: ONE upper join keyed on a fact column, adjacent to the
+        fact join, whose other side is an unfiltered scan chain (the
+        secondary dim), with exactly one extra key pair coupling a PRIMARY
+        column to a secondary column:
+
+            J2: [fact.F2 = sec.S_KEY, prim.P = sec.S_ATTR]
+
+        The aggregation then runs per S_ATTR value on device: a STATIC
+        mapped column M[row] = S_ATTR of row's F2 (valid because the
+        secondary base is unfiltered) compared against the per-rank primary
+        coupling value and the query-time allowed S_ATTR set. Joins above
+        J2 fold into the secondary plan (supplier * nation * region for q5)
+        and must not touch fact or primary columns. Raises to fall back."""
+        from ballista_tpu.logical.plan import JoinType
+
+        if offending != [len(path) - 1]:
+            raise UnsupportedOnDevice("fact-column upper join not adjacent")
+        j2, side2 = path[-1]
+        if j2.join_type != JoinType.INNER or j2.filter is not None:
+            raise UnsupportedOnDevice("secondary join shape")
+        if side2 != "left" or any(s != "left" for _j, s in path):
+            # fact+primary under j2.left keeps the secondary block a suffix
+            # of the flattened schema
+            raise UnsupportedOnDevice("secondary fold needs left-leaning joins")
+        sec_base = j2.right
+        if _scan_chain_leaf(sec_base) is None:
+            raise UnsupportedOnDevice("secondary side is not a scan chain")
+        node = sec_base
+        while isinstance(node, (ProjectionExec, CoalesceBatchesExec, FilterExec)):
+            if isinstance(node, FilterExec):
+                # the static map must not depend on query-time predicates
+                raise UnsupportedOnDevice("filtered secondary base")
+            node = node.input
+        sec_names = set(sec_base.schema().names)
+        prim_plan = join.left if fact_side == "right" else join.right
+        prim_names = set(prim_plan.schema().names)
+        f2 = s_key = p = s_attr = None
+        for ln, rn in j2.on:
+            lef, rig = (ln, rn) if rn in sec_names else (rn, ln)
+            if rig not in sec_names:
+                raise UnsupportedOnDevice("secondary join key resolution")
+            if lef in fact_names:
+                if f2 is not None:
+                    raise UnsupportedOnDevice("two fact-keyed pairs")
+                f2, s_key = lef, rig
+            elif lef in prim_names:
+                if p is not None:
+                    raise UnsupportedOnDevice("two coupling pairs")
+                p, s_attr = lef, rig
+            else:
+                raise UnsupportedOnDevice("secondary join key from unknown side")
+        if f2 is None or p is None:
+            raise UnsupportedOnDevice("secondary join missing fact key or coupling")
+        for j, _s in path[:-1]:
+            for ln, rn in j.on:
+                if {ln, rn} & (fact_names | prim_names):
+                    raise UnsupportedOnDevice("upper join not secondary-only")
+        sec_plan = sec_base
+        for j, s in reversed(path[:-1]):
+            children = [j.left, j.right]
+            children[0 if s == "left" else 1] = sec_plan
+            sec_plan = j.with_children(children)
+        self.secondary = {
+            "plan": sec_plan,
+            "base": sec_base,
+            "f2": f2,
+            "s_key": s_key,
+            "p": p,
+            "s_attr": s_attr,
+            "sec_start": len(j2.left.schema()),
+        }
+
+    # ------------------------------------------------------------------
+    def _ensure_sec_map(self, ctx) -> None:
+        """Static secondary mapping: sorted base S_KEYs and their S_ATTRs.
+        Valid across queries because the base chain is unfiltered."""
+        if self._sec_map is not None:
+            return
+        from ballista_tpu.physical.plan import collect_all
+
+        sec = self.secondary
+        base = collect_all(sec["base"], ctx)
+        if base.num_rows > MAX_DIM_ROWS:
+            raise UnsupportedOnDevice("secondary base too large")
+        k = base.column(sec["s_key"]).to_numpy(zero_copy_only=False)
+        a = base.column(sec["s_attr"]).to_numpy(zero_copy_only=False)
+        if not (np.issubdtype(k.dtype, np.integer) and np.issubdtype(a.dtype, np.integer)):
+            raise UnsupportedOnDevice("secondary keys must be integers")
+        if len(a) and int(a.min()) < 0:
+            raise UnsupportedOnDevice("negative secondary attribute")
+        order = np.argsort(k, kind="stable")
+        ks = k[order]
+        if len(np.unique(ks)) != len(ks):
+            raise UnsupportedOnDevice("secondary key not unique")
+        self._sec_map = (ks.astype(np.int64), a[order].astype(np.int32))
+
+    def _derive_sec_attr(self, npcols) -> np.ndarray:
+        """Row-space static mapped column: S_ATTR of each row's F2 value
+        (-1 when the base holds no such key — the row can never qualify)."""
+        keys, attrs = self._sec_map
+        f2 = npcols[self.secondary["f2_scan_idx"]].astype(np.int64)
+        if len(keys) == 0:
+            return np.full(len(f2), -1, dtype=np.int32)
+        pos = np.clip(np.searchsorted(keys, f2), 0, len(keys) - 1)
+        matched = keys[pos] == f2
+        return np.where(matched, attrs[pos], -1).astype(np.int32)
+
+    def _sec_side(self, ctx) -> dict:
+        """Query-time secondary plan: allowed S_ATTR classes and the group
+        key values attached to each. Declines when qualification is not a
+        pure function of S_ATTR (the static map cannot express per-key
+        filtering) or when group values are not unique per class."""
+        if self._sec_cache is not None:
+            return self._sec_cache
+        from ballista_tpu.physical.plan import collect_all
+
+        sec = self.secondary
+        self._ensure_sec_map(ctx)
+        base_keys, base_attrs = self._sec_map
+        table = collect_all(sec["plan"], ctx)
+        attrs = table.column(sec["s_attr"]).to_numpy(zero_copy_only=False)
+        keys = table.column(sec["s_key"]).to_numpy(zero_copy_only=False)
+        pairs = np.unique(np.stack([attrs.astype(np.int64), keys.astype(np.int64)]), axis=1)
+        if pairs.shape[1] != len(attrs):
+            # duplicate (attr, key) rows: an upper secondary join multiplies
+            # supplier rows, so each fact row should count more than once —
+            # the per-class device mask cannot express that
+            raise UnsupportedOnDevice("secondary plan multiplies rows")
+        allowed, sec_counts = np.unique(pairs[0], return_counts=True)
+        b_allowed, b_counts = np.unique(
+            base_attrs[np.isin(base_attrs, allowed.astype(np.int32))],
+            return_counts=True,
+        )
+        if not (
+            len(allowed) == len(b_allowed)
+            and (allowed == b_allowed).all()
+            and (sec_counts == b_counts).all()
+        ):
+            raise UnsupportedOnDevice("secondary qualification not attr-pure")
+        if len(allowed) > 256:
+            raise UnsupportedOnDevice("too many secondary classes")
+        # group values: unique per class, gathered in `allowed` order
+        group_values = {}
+        first_row_for_attr = {}
+        for i, v in enumerate(attrs):
+            first_row_for_attr.setdefault(int(v), i)
+        for name, _out in sec["group_cols"]:
+            col = table.column(name)
+            enc = pc.dictionary_encode(col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col)
+            codes = enc.indices.to_numpy(zero_copy_only=False)
+            if len(np.unique(np.stack([attrs.astype(np.int64), codes.astype(np.int64)]), axis=1)[0]) != len(allowed):
+                raise UnsupportedOnDevice("group key not unique per secondary class")
+            take = pa.array([first_row_for_attr[int(v)] for v in allowed], type=pa.int64())
+            group_values[name] = col.take(take) if not isinstance(col, pa.ChunkedArray) else col.combine_chunks().take(take)
+        out = {"allowed": allowed.astype(np.int32), "group_values": group_values}
+        if ctx.config.device_cache():
+            self._sec_cache = out
+        return out
+
+    def _build_sec_step(self):
+        """Per-class masked full reductions: ONE jit call computes every
+        aggregate state for every allowed S_ATTR class. GA is padded to a
+        power of two (sentinel -2 never matches) to bound retracing."""
+        import jax
+        import jax.numpy as jnp
+
+        inner = self.inner
+        filter_fns = inner.filter_fns
+
+        @jax.jit
+        def step_sec(cols, aux, pad, m_tiles, p_rank, allowed):
+            mask0 = pad
+            for f in filter_fns:
+                mask0 = jnp.logical_and(mask0, f.fn(cols, aux))
+            outs = []
+            for g in range(allowed.shape[0]):
+                a = allowed[g]
+                m = jnp.logical_and(mask0, m_tiles == a)
+                # coupling: the rank's primary value must equal the class
+                # (non-member ranks carry -1 and never match)
+                m = jnp.logical_and(m, (p_rank == a)[:, None])
+                outs.append(
+                    inner._emit_rows(
+                        cols, aux, m,
+                        counts=jnp.sum(m, dtype=jnp.int32),
+                        reduce_sum=lambda v, zero: jnp.sum(v),
+                        reduce_extreme=lambda v, fill, red: red(v),
+                    )
+                )
+            return jnp.stack(outs, axis=1)  # [R_packed, GA_pad]
+
+        return step_sec
+
+    def _run_secondary(self, ent: dict, ctx) -> pa.Table:
+        import jax.numpy as jnp
+
+        sec = self.secondary
+        info = self._sec_side(ctx)
+        prim = self._dim_side(ctx)
+        if (
+            ent["kind"] == "empty"
+            or len(info["allowed"]) == 0
+            or prim["table"].num_rows == 0
+        ):
+            return self.partial_schema.empty_table()
+        # per-rank coupling value from the primary side (-1 = no match)
+        p_col = prim["table"].column(sec["p"]).to_numpy(zero_copy_only=False)
+        if not np.issubdtype(p_col.dtype, np.integer):
+            raise UnsupportedOnDevice("coupling column must be integer")
+        rank_keys = ent["rank_keys"]
+        pos = np.clip(
+            np.searchsorted(prim["keys_sorted"], rank_keys),
+            0, max(0, len(prim["keys_sorted"]) - 1),
+        )
+        matched = prim["keys_sorted"][pos] == rank_keys
+        p_sorted = p_col[prim["order"]]
+        p_rank = np.where(matched, p_sorted[pos], -1).astype(np.int32)
+
+        GA = len(info["allowed"])
+        ga_pad = 1
+        while ga_pad < GA:
+            ga_pad <<= 1
+        allowed_pad = np.full(ga_pad, -2, dtype=np.int32)
+        allowed_pad[:GA] = info["allowed"]
+        if self._sec_step is None:
+            self._sec_step = self._build_sec_step()
+        aux = [jnp.asarray(a) for a in self.inner.compiler.build_aux()]
+        packed = np.asarray(
+            self._sec_step(
+                ent["cols"], aux, ent["pad"], ent["derived"]["sec_attr"],
+                jnp.asarray(p_rank), jnp.asarray(allowed_pad),
+            )
+        )
+        rows = self._decode(packed)
+        counts = rows[0][:GA]
+        keep = counts > 0
+        fields = list(self.partial_schema)
+        arrays: List[pa.Array] = []
+        fi = 0
+        keep_idx = pa.array(np.flatnonzero(keep).astype(np.int64))
+        for name, _out in sec["group_cols"]:
+            f = fields[fi]
+            arr = info["group_values"][name].take(keep_idx)
+            if arr.type != f.type:
+                arr = pc.cast(arr, f.type)
+            arrays.append(arr)
+            fi += 1
+        state_rows = rows[1:]
+        ri = 0
+        nonempty = counts[keep]
+        for a in self.aggs:
+            for _sf in a.state_fields():
+                f = fields[fi]
+                raw = state_rows[ri][:GA][keep]
+                if a.fn in ("min", "max"):
+                    arr = pa.array(raw.astype(np.float64), mask=nonempty == 0)
+                else:
+                    arr = pa.array(raw.astype(np.float64))
+                if arr.type != f.type:
+                    arr = pc.cast(arr, f.type)
+                arrays.append(arr)
+                ri += 1
+                fi += 1
+        return pa.table(arrays, schema=self.partial_schema)
 
     # ------------------------------------------------------------------
     def _score_row(self) -> int:
@@ -416,6 +752,8 @@ class FactAggregateStage:
         ent = self._prepared.get(partition)
         if ent is not None:
             return ent
+        if self.secondary is not None:
+            self._ensure_sec_map(ctx)  # the derived column needs the map
         ent = self.inner._prepare_partition_sorted(partition, ctx)
         use_cache = ctx.config.device_cache()
         if ent["kind"] == "sorted":
@@ -439,6 +777,8 @@ class FactAggregateStage:
     def run(self, partition: int, ctx) -> pa.Table:
         import jax.numpy as jnp
 
+        if self.secondary is not None:
+            return self._run_secondary(self._prepare(partition, ctx), ctx)
         dim = self._dim_side(ctx)
         ent = self._prepare(partition, ctx)
         if ent["kind"] == "empty" or dim["table"].num_rows == 0:

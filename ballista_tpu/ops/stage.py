@@ -206,6 +206,9 @@ class FusedAggregateStage:
                 mapping = [substitute_columns(e, mapping) for e, _ in payload]
             else:
                 filters.append(substitute_columns(payload, mapping))
+        # input-schema -> scan-schema expr map, exposed for composers
+        # (FactAggregateStage re-expresses extra columns through it)
+        self.input_to_scan = mapping
 
         self.group_exprs = [
             (substitute_columns(e, mapping), name) for e, name in agg.group_exprs
@@ -250,6 +253,10 @@ class FusedAggregateStage:
         self._step = self._build_step()
         self._sorted_step = None  # built on first high-cardinality partition
         self._device_cache: Dict[int, dict] = {}
+        # name -> fn(row-space npcols dict) -> np row array; materialized as
+        # [V, L1] tiles alongside the scan columns on the sorted path
+        # (FactAggregateStage derives static mapped columns this way)
+        self.derive_columns: Dict[str, Callable] = {}
 
     @staticmethod
     def _partial_schema(agg) -> pa.Schema:
@@ -621,6 +628,10 @@ class FusedAggregateStage:
         cols: Dict[int, object] = {}
         for idx, npcol in npcols.items():
             cols[idx] = jnp.asarray(layout.materialize(npcol))
+        derived = {
+            name: jnp.asarray(layout.materialize(fn(npcols)))
+            for name, fn in self.derive_columns.items()
+        }
         if self._sorted_step is None:
             self._sorted_step = self._build_sorted_step()
         return {
@@ -630,6 +641,7 @@ class FusedAggregateStage:
             "pad": jnp.asarray(layout.pad),
             "key_values": key_values,
             "n_groups": n_groups,
+            "derived": derived,
         }
 
     def _prepare_pallas_sorted(self, batch, codes, key_values, n_groups) -> dict:

@@ -93,12 +93,19 @@ class SchedulerServer:
             plan = plan_sql(request.sql, self.catalog)
             if isinstance(plan, lp.CreateExternalTable):
                 check_scan_roots_path(plan.location, roots)
+                key = plan.name.lower()
+                prior = self.catalog.tables.get(key)
                 self.catalog._create_external_table(plan)
-                src = self.catalog.tables.get(plan.name.lower())
+                src = self.catalog.tables.get(key)
                 try:
                     check_scan_files(getattr(src, "files", []) or [], roots)
                 except Exception:
-                    self.catalog.tables.pop(plan.name.lower(), None)
+                    # restore the pre-existing registration (a failing CET
+                    # must not unregister someone else's table)
+                    if prior is None:
+                        self.catalog.tables.pop(key, None)
+                    else:
+                        self.catalog.tables[key] = prior
                     raise
                 return pb.ExecuteQueryResult(job_id="")
         else:

@@ -293,3 +293,137 @@ def test_topk_int_sum_f32_collapse_boundary(tmp_path):
     h = _ctx("host", tmp_path).sql(sql).collect()
     assert t.column("s").to_pylist() == h.column("s").to_pylist()
     assert (base + 1) in t.column("s").to_pylist()
+
+
+@pytest.fixture
+def coupled_star(tmp_path):
+    """q5-shaped schema: fact joins a secondary dim on a fact column, with
+    an attribute coupling between primary and secondary dims."""
+    rng = np.random.default_rng(11)
+    n_orders, n_supp, nf = 900, 50, 24_000
+    orders = pa.table(
+        {
+            "o_key": pa.array(np.arange(n_orders), type=pa.int64()),
+            "o_flag": pa.array(rng.integers(0, 2, n_orders), type=pa.int64()),
+            "c_nat": pa.array(rng.integers(0, 8, n_orders), type=pa.int64()),
+        }
+    )
+    supplier = pa.table(
+        {
+            "s_key": pa.array(np.arange(n_supp), type=pa.int64()),
+            "s_nat": pa.array(rng.integers(0, 8, n_supp), type=pa.int64()),
+        }
+    )
+    nation = pa.table(
+        {
+            "nat_key": pa.array(np.arange(8), type=pa.int64()),
+            "nat_name": pa.array([f"nation-{i}" for i in range(8)]),
+            "nat_region": pa.array([i % 2 for i in range(8)], type=pa.int64()),
+        }
+    )
+    fact = pa.table(
+        {
+            "f_okey": pa.array(rng.integers(0, n_orders, nf), type=pa.int64()),
+            "f_skey": pa.array(rng.integers(0, n_supp, nf), type=pa.int64()),
+            "amount": pa.array(np.round(rng.uniform(1, 100, nf), 2)),
+        }
+    )
+    pq.write_table(fact, str(tmp_path / "fact.parquet"))
+    pq.write_table(orders, str(tmp_path / "orders.parquet"))
+    pq.write_table(supplier, str(tmp_path / "supplier.parquet"))
+    pq.write_table(nation, str(tmp_path / "nation.parquet"))
+    return tmp_path
+
+
+Q_COUPLED = """
+    select nat_name, sum(amount) as rev
+    from orders, fact, supplier, nation
+    where o_key = f_okey and f_skey = s_key and c_nat = s_nat
+      and s_nat = nat_key and nat_region = 1 and o_flag = 1
+    group by nat_name
+    order by nat_name
+"""
+
+
+def _coupled_ctx(backend, star):
+    ctx = ExecutionContext(BallistaConfig({"ballista.executor.backend": backend}))
+    for t in ("fact", "orders", "supplier", "nation"):
+        ctx.register_parquet(t, str(star / f"{t}.parquet"))
+    return ctx
+
+
+def test_coupled_secondary_dim_matches_host(coupled_star):
+    """q5 shape: upper join keyed on a fact column with a primary<->secondary
+    attribute coupling runs per-class on device (static mapped column)."""
+    kernels._stage_cache.clear()
+    t = _coupled_ctx("tpu", coupled_star).sql(Q_COUPLED).collect()
+    h = _coupled_ctx("cpu", coupled_star).sql(Q_COUPLED).collect()
+    stages = _factagg_stages()
+    assert stages and stages[0].secondary is not None
+    assert t.column("nat_name").to_pylist() == h.column("nat_name").to_pylist()
+    np.testing.assert_allclose(
+        np.array(t.column("rev").to_pylist()),
+        np.array(h.column("rev").to_pylist()), rtol=1e-4,
+    )
+
+
+def test_coupled_secondary_impure_filter_falls_back(coupled_star):
+    """A secondary-side filter that is NOT a pure function of the coupling
+    attribute (here: on s_key itself) invalidates the static map — the
+    stage must decline and the host fallback must stay correct."""
+    sql = Q_COUPLED.replace("and o_flag = 1", "and o_flag = 1 and s_key < 25")
+    kernels._stage_cache.clear()
+    t = _coupled_ctx("tpu", coupled_star).sql(sql).collect()
+    h = _coupled_ctx("cpu", coupled_star).sql(sql).collect()
+    assert t.column("nat_name").to_pylist() == h.column("nat_name").to_pylist()
+    np.testing.assert_allclose(
+        np.array(t.column("rev").to_pylist()),
+        np.array(h.column("rev").to_pylist()), rtol=1e-4,
+    )
+
+
+def test_semi_join_folds_into_membership(tmp_path):
+    """q18 shape: a SEMI join above the fact's inner join folds whole into
+    the dim-plan membership and the aggregation stays on device."""
+    rng = np.random.default_rng(17)
+    n_orders, nf = 600, 18_000
+    orders = pa.table(
+        {
+            "o_key": pa.array(np.arange(n_orders), type=pa.int64()),
+            "o_name": pa.array([f"o{i}" for i in range(n_orders)]),
+        }
+    )
+    fact = pa.table(
+        {
+            "f_okey": pa.array(rng.integers(0, n_orders, nf), type=pa.int64()),
+            "qty": pa.array(np.round(rng.uniform(1, 50, nf), 2)),
+        }
+    )
+    pq.write_table(fact, str(tmp_path / "fact.parquet"))
+    pq.write_table(orders, str(tmp_path / "orders.parquet"))
+    sql = """
+        select o_name, o_key, sum(qty) as s
+        from orders, fact
+        where o_key = f_okey
+          and o_key in (select f_okey from fact group by f_okey
+                        having sum(qty) > 800)
+        group by o_name, o_key
+        order by o_key
+    """
+    outs = {}
+    for backend in ("tpu", "cpu"):
+        kernels._stage_cache.clear()
+        ctx = ExecutionContext(BallistaConfig({"ballista.executor.backend": backend}))
+        ctx.register_parquet("fact", str(tmp_path / "fact.parquet"))
+        ctx.register_parquet("orders", str(tmp_path / "orders.parquet"))
+        outs[backend] = ctx.sql(sql).collect()
+        if backend == "tpu":
+            stages = _factagg_stages()
+            assert stages, "device stage did not build for the semi fold"
+    t, h = outs["tpu"], outs["cpu"]
+    assert t.num_rows == h.num_rows > 0
+    assert t.column("o_key").to_pylist() == h.column("o_key").to_pylist()
+    np.testing.assert_allclose(
+        np.array(t.column("s").to_pylist()),
+        np.array(h.column("s").to_pylist()), rtol=1e-4,
+    )
