@@ -29,16 +29,20 @@ def _real_roots(roots: List[str]) -> List[str]:
     return [os.path.realpath(r) for r in roots]
 
 
+def _contained_real(p: str, r: str) -> bool:
+    """The single containment comparison for every trust boundary here —
+    both arguments must already be realpath'd; a hardening fix to the rule
+    itself lands everywhere at once."""
+    return os.path.commonpath([r, p]) == r
+
+
 def resolve_contained(path: str, root: str):
-    """The single containment primitive for every trust boundary here — the
-    Flight work_dir check and the shuffle local-read check use it too, so a
-    hardening fix lands everywhere at once. Returns the RESOLVED path when
-    it lies inside root (symlinks followed), else None — callers must use
-    the returned string, never re-resolve (a second realpath of a swapped
-    symlink could escape the check)."""
+    """Returns the RESOLVED path when it lies inside root (symlinks
+    followed), else None — callers must use the returned string, never
+    re-resolve (a second realpath of a swapped symlink could escape the
+    check)."""
     p = os.path.realpath(path)
-    r = os.path.realpath(root)
-    return p if os.path.commonpath([r, p]) == r else None
+    return p if _contained_real(p, os.path.realpath(root)) else None
 
 
 def contained(path: str, root: str) -> bool:
@@ -46,7 +50,8 @@ def contained(path: str, root: str) -> bool:
 
 
 def _under(path: str, real_roots: List[str]) -> bool:
-    return any(resolve_contained(path, root) is not None for root in real_roots)
+    p = os.path.realpath(path)
+    return any(_contained_real(p, r) for r in real_roots)
 
 
 def _walk_messages(msg) -> Iterator:

@@ -155,13 +155,127 @@ class PyArrowEngine:
         return out.slice(0, 10)
 
 
+    def _q5(self) -> pa.Table:
+        import datetime
+
+        lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+        orders = self._t("orders")
+        orders = orders.filter(
+            pc.and_(
+                pc.greater_equal(orders.column("o_orderdate"), pa.scalar(lo)),
+                pc.less(orders.column("o_orderdate"), pa.scalar(hi)),
+            )
+        ).select(["o_orderkey", "o_custkey"])
+        cust = self._t("customer").select(["c_custkey", "c_nationkey"])
+        li = self._t("lineitem").select(
+            ["l_orderkey", "l_suppkey", "l_extendedprice", "l_discount"]
+        )
+        supp = self._t("supplier").select(["s_suppkey", "s_nationkey"])
+        nat = self._t("nation").select(["n_nationkey", "n_name", "n_regionkey"])
+        reg = self._t("region")
+        reg = reg.filter(pc.equal(reg.column("r_name"), pa.scalar("ASIA"))).select(
+            ["r_regionkey"]
+        )
+        j = orders.join(cust, keys="o_custkey", right_keys="c_custkey", join_type="inner")
+        j = li.join(j, keys="l_orderkey", right_keys="o_orderkey", join_type="inner")
+        j = j.join(supp, keys="l_suppkey", right_keys="s_suppkey", join_type="inner")
+        j = j.filter(pc.equal(j.column("c_nationkey"), j.column("s_nationkey")))
+        j = j.join(nat, keys="s_nationkey", right_keys="n_nationkey", join_type="inner")
+        j = j.join(reg, keys="n_regionkey", right_keys="r_regionkey", join_type="inner")
+        rev = pc.multiply(
+            j.column("l_extendedprice"),
+            pc.subtract(pa.scalar(1.0), j.column("l_discount")),
+        )
+        out = j.append_column("rev", rev).group_by(["n_name"]).aggregate(
+            [("rev", "sum")]
+        )
+        return out.sort_by([("rev_sum", "descending")])
+
+    def _q10(self) -> pa.Table:
+        import datetime
+
+        lo, hi = datetime.date(1993, 10, 1), datetime.date(1994, 1, 1)
+        orders = self._t("orders")
+        orders = orders.filter(
+            pc.and_(
+                pc.greater_equal(orders.column("o_orderdate"), pa.scalar(lo)),
+                pc.less(orders.column("o_orderdate"), pa.scalar(hi)),
+            )
+        ).select(["o_orderkey", "o_custkey"])
+        li = self._t("lineitem")
+        li = li.filter(pc.equal(li.column("l_returnflag"), pa.scalar("R"))).select(
+            ["l_orderkey", "l_extendedprice", "l_discount"]
+        )
+        cust = self._t("customer").select(
+            ["c_custkey", "c_name", "c_acctbal", "c_phone", "c_nationkey",
+             "c_address", "c_comment"]
+        )
+        nat = self._t("nation").select(["n_nationkey", "n_name"])
+        j = li.join(orders, keys="l_orderkey", right_keys="o_orderkey", join_type="inner")
+        j = j.join(cust, keys="o_custkey", right_keys="c_custkey", join_type="inner")
+        j = j.join(nat, keys="c_nationkey", right_keys="n_nationkey", join_type="inner")
+        rev = pc.multiply(
+            j.column("l_extendedprice"),
+            pc.subtract(pa.scalar(1.0), j.column("l_discount")),
+        )
+        out = (
+            j.append_column("rev", rev)
+            .group_by(["o_custkey", "c_name", "c_acctbal", "c_phone", "n_name",
+                       "c_address", "c_comment"])
+            .aggregate([("rev", "sum")])
+        )
+        out = out.sort_by([("rev_sum", "descending")]).slice(0, 20)
+        # query column order (revenue third), so the cross-check's
+        # first-float-column heuristic compares revenue on every engine
+        return out.select(
+            ["o_custkey", "c_name", "rev_sum", "c_acctbal", "n_name",
+             "c_address", "c_phone", "c_comment"]
+        )
+
+    def _q12(self) -> pa.Table:
+        import datetime
+
+        lo, hi = datetime.date(1994, 1, 1), datetime.date(1995, 1, 1)
+        li = self._t("lineitem")
+        li = li.filter(
+            pc.and_(
+                pc.and_(
+                    pc.is_in(li.column("l_shipmode"),
+                             value_set=pa.array(["MAIL", "SHIP"])),
+                    pc.less(li.column("l_commitdate"), li.column("l_receiptdate")),
+                ),
+                pc.and_(
+                    pc.less(li.column("l_shipdate"), li.column("l_commitdate")),
+                    pc.and_(
+                        pc.greater_equal(li.column("l_receiptdate"), pa.scalar(lo)),
+                        pc.less(li.column("l_receiptdate"), pa.scalar(hi)),
+                    ),
+                ),
+            )
+        ).select(["l_orderkey", "l_shipmode"])
+        orders = self._t("orders").select(["o_orderkey", "o_orderpriority"])
+        j = li.join(orders, keys="l_orderkey", right_keys="o_orderkey", join_type="inner")
+        high = pc.is_in(j.column("o_orderpriority"),
+                        value_set=pa.array(["1-URGENT", "2-HIGH"]))
+        highf = pc.cast(high, pa.float64())
+        j = j.append_column("high", highf).append_column(
+            "low", pc.subtract(pa.scalar(1.0), highf)
+        )
+        out = j.group_by(["l_shipmode"]).aggregate([("high", "sum"), ("low", "sum")])
+        return out.sort_by([("l_shipmode", "ascending")])
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--data", default=str(REPO / ".bench_cache" / "tpch_sf1.0"))
-    ap.add_argument("--queries", nargs="+", default=["q1", "q3", "q6"])
+    ap.add_argument("--queries", nargs="+",
+                    default=["q1", "q3", "q5", "q6", "q10", "q12"])
     ap.add_argument("--iterations", type=int, default=3)
     ap.add_argument("--engines", nargs="+", default=["tpu", "host", "pyarrow"])
+    ap.add_argument("--strict", action="store_true",
+                    help="exit nonzero when engines disagree (CI mode)")
     args = ap.parse_args()
+    mismatches = 0
 
     engines: Dict[str, object] = {}
     for e in args.engines:
@@ -198,6 +312,7 @@ def main() -> None:
                 base_name, base_rows, base_vals = name, out.num_rows, vals
                 continue
             if out.num_rows != base_rows:
+                mismatches += 1
                 print(f"WARNING: {q}: {name} rows={out.num_rows} != "
                       f"{base_name} rows={base_rows}", file=sys.stderr)
             elif (
@@ -205,6 +320,7 @@ def main() -> None:
                 and base_vals is not None
                 and not np.allclose(vals, base_vals, rtol=1e-3)
             ):
+                mismatches += 1
                 print(f"WARNING: {q}: {name} values disagree with {base_name}",
                       file=sys.stderr)
         ref = times.get("host") or next(iter(times.values()))
@@ -218,6 +334,9 @@ def main() -> None:
         fastest = min(times, key=times.get)
         print(f"| {q} | " + " | ".join(cells) +
               f" | {fastest} {ref / times[fastest]:.2f}x |")
+    if args.strict and mismatches:
+        print(f"{mismatches} cross-engine mismatches", file=sys.stderr)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -29,3 +29,9 @@ for q in (1, 3, 5, 6, 10, 12):
 cluster.shutdown()
 print("integration tests passed")
 PY
+
+# cross-engine comparison on the same data: hand-written pyarrow
+# implementations validate the CI query set (the reference's Spark
+# comparison role); host engine only — the TPU relay may be absent in CI
+python -m benchmarks.compare --data "$DATA" \
+    --queries q1 q3 q5 q6 q10 q12 --iterations 1 --engines host pyarrow --strict
