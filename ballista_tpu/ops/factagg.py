@@ -420,8 +420,7 @@ class FactAggregateStage:
                 raise UnsupportedOnDevice("secondary join key from unknown side")
         if f2 is None or p is None:
             raise UnsupportedOnDevice("secondary join missing fact key or coupling")
-        p_field = prim_plan.schema().field(prim_plan.schema().names.index(p))
-        if not pa.types.is_integer(p_field.type):
+        if not pa.types.is_integer(prim_plan.schema().field(p).type):
             raise UnsupportedOnDevice("coupling column must be integer")
         for j, _s in path[:-1]:
             for ln, rn in j.on:

@@ -38,12 +38,19 @@ class HashJoinExec(ExecutionPlan):
         on: List[Tuple[str, str]],  # (left column name, right column name)
         join_type: JoinType,
         filter=None,  # residual PhysicalExpr over concat(left, right) schema
+        partitioned: bool = False,
     ) -> None:
         self.left = left
         self.right = right
         self.on = on
         self.join_type = join_type
         self.filter = filter
+        # both inputs hash-co-partitioned on the join keys: each partition
+        # pair joins independently (the planner arranges this for outer
+        # joins, removing the single-partition probe wall — every key lands
+        # in exactly one partition, so per-partition unmatched rows are
+        # globally unmatched)
+        self.partitioned = partitioned
         if filter is not None and join_type not in (JoinType.SEMI, JoinType.ANTI):
             raise PlanError("join residual filter only supported for SEMI/ANTI")
         if join_type in (JoinType.SEMI, JoinType.ANTI):
@@ -66,7 +73,8 @@ class HashJoinExec(ExecutionPlan):
 
     def with_children(self, children: List[ExecutionPlan]) -> "HashJoinExec":
         return HashJoinExec(
-            children[0], children[1], self.on, self.join_type, filter=self.filter
+            children[0], children[1], self.on, self.join_type,
+            filter=self.filter, partitioned=self.partitioned,
         )
 
     def _collect_build(self, side: ExecutionPlan, ctx: TaskContext) -> pa.Table:
@@ -96,7 +104,10 @@ class HashJoinExec(ExecutionPlan):
             yield from batch_table(out, ctx.batch_size)
             return
 
-        build = self._collect_build(self.left, ctx)
+        if self.partitioned:
+            build = collect_partition(self.left, partition, ctx)
+        else:
+            build = self._collect_build(self.left, ctx)
         probe = collect_partition(self.right, partition, ctx)
         if (self.join_type == JoinType.INNER and ctx.backend == "tpu"
                 and ctx.config.tpu_device_join()):
@@ -123,10 +134,14 @@ class HashJoinExec(ExecutionPlan):
             JoinType.RIGHT: "right",
             JoinType.FULL: "full",
         }[self.join_type]
-        if how in ("left", "full") and self.right.output_partitioning().partition_count() > 1:
+        if (
+            how in ("left", "full")
+            and not self.partitioned
+            and self.right.output_partitioning().partition_count() > 1
+        ):
             raise PlanError(
-                f"{how} join requires single-partition probe side "
-                "(planner must insert MergeExec)"
+                f"{how} join requires co-partitioned inputs or a "
+                "single-partition probe side"
             )
         left_idx, right_idx = join_indices(bcodes, pcodes, how)
         left_out = take_table(build, left_idx)

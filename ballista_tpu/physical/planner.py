@@ -309,9 +309,51 @@ class PhysicalPlanner:
                     right.schema().field(rcol.index_in(right.schema())).name,
                 )
             )
+        partitioned = False
         if plan.join_type in (lp.JoinType.LEFT, lp.JoinType.FULL):
-            if right.output_partitioning().partition_count() > 1:
-                right = MergeExec(right)
+            nl = left.output_partitioning().partition_count()
+            nr = right.output_partitioning().partition_count()
+            if nr > 1 or nl > 1:
+                # co-partition BOTH sides on the join keys so every key
+                # lands in one partition and each pair joins independently
+                # — outer rows stay correct with no single-partition merge
+                # (the old MergeExec scalability wall). A side already
+                # hash-partitioned on exactly its join keys keeps its
+                # existing exchange (no redundant shuffle).
+                def hashed_on(side, names):
+                    part = side.output_partitioning()
+                    return (
+                        part.scheme == "hash"
+                        and len(part.exprs) == len(names)
+                        and all(
+                            isinstance(e, ColumnExpr) and e.name == k
+                            for e, k in zip(part.exprs, names)
+                        )
+                    )
+
+                lnames = [l for l, _ in on]
+                rnames = [r for _, r in on]
+                l_ok = hashed_on(left, lnames)
+                r_ok = hashed_on(right, rnames)
+                if l_ok and (not r_ok or nl >= nr):
+                    n = nl
+                elif r_ok:
+                    n = nr
+                else:
+                    n = max(nl, nr)
+                if not (l_ok and nl == n):
+                    lexprs = [
+                        ColumnExpr(lname, left.schema().names.index(lname))
+                        for lname in lnames
+                    ]
+                    left = RepartitionExec(left, Partitioning.hash(lexprs, n))
+                if not (r_ok and nr == n):
+                    rexprs = [
+                        ColumnExpr(rname, right.schema().names.index(rname))
+                        for rname in rnames
+                    ]
+                    right = RepartitionExec(right, Partitioning.hash(rexprs, n))
+                partitioned = True
         if plan.join_type in (lp.JoinType.SEMI, lp.JoinType.ANTI):
             # residual predicates evaluate over concat(left, right) during
             # the join itself (the right side is absent from the output)
@@ -322,7 +364,9 @@ class PhysicalPlanner:
                 )
                 pfilter = create_physical_expr(plan.filter, concat_schema)
             return HashJoinExec(left, right, on, plan.join_type, filter=pfilter)
-        join: ExecutionPlan = HashJoinExec(left, right, on, plan.join_type)
+        join: ExecutionPlan = HashJoinExec(
+            left, right, on, plan.join_type, partitioned=partitioned
+        )
         if plan.filter is not None:
             join = FilterExec(join, create_physical_expr(plan.filter, join.schema()))
         return join

@@ -155,6 +155,7 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             n.join.left_keys.append(l)
             n.join.right_keys.append(r)
         n.join.join_type = plan.join_type.value
+        n.join.partitioned = plan.partitioned
         if plan.filter is not None:
             n.join.filter.CopyFrom(expr_to_proto(uncompile_expr(plan.filter)))
     elif isinstance(plan, CrossJoinExec):
@@ -319,7 +320,9 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
         if n.join.HasField("filter"):
             concat = pa.schema(list(left.schema()) + list(right.schema()))
             filt = create_physical_expr(expr_from_proto(n.join.filter), concat)
-        return HashJoinExec(left, right, on, jt, filter=filt)
+        return HashJoinExec(
+            left, right, on, jt, filter=filt, partitioned=n.join.partitioned
+        )
     if which == "cross_join":
         return CrossJoinExec(
             phys_plan_from_proto(n.cross_join.left),

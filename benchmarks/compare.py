@@ -304,10 +304,18 @@ def main() -> None:
         base_name = base_rows = base_vals = None
         for name, out in results.items():
             vals = None
-            for i, f in enumerate(out.schema):
-                if pa.types.is_floating(f.type):
-                    vals = np.sort(np.array(out.column(i), dtype=float))
-                    break
+            # first float column (measure columns like revenue — their
+            # sorted multiset is tie-invariant); when none exists (q12's
+            # int64 counts) fall back to the first integer column so the
+            # strict gate still value-checks
+            idx = next(
+                (i for i, f in enumerate(out.schema)
+                 if pa.types.is_floating(f.type)),
+                next((i for i, f in enumerate(out.schema)
+                      if pa.types.is_integer(f.type)), None),
+            )
+            if idx is not None:
+                vals = np.sort(np.array(out.column(idx).to_pylist(), dtype=float))
             if base_name is None:
                 base_name, base_rows, base_vals = name, out.num_rows, vals
                 continue

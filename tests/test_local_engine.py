@@ -160,3 +160,82 @@ def test_explain(ctx, sales_table):
     _register(ctx, sales_table)
     text = ctx.table("sales").select(col("id")).explain()
     assert "Logical Plan" in text and "ProjectionExec" in text
+
+
+def test_left_join_multi_partition_no_merge():
+    """LEFT/FULL joins with multi-partition inputs run co-partitioned (both
+    sides hash-repartitioned on the join keys) instead of collapsing the
+    probe side through MergeExec — outer rows stay correct because every
+    key lands in exactly one partition."""
+    import numpy as np
+
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.physical.basic import MergeExec
+    from ballista_tpu.physical.join import HashJoinExec
+
+    rng = np.random.default_rng(21)
+    n = 5000
+    left = pa.table(
+        {
+            "k": pa.array(rng.integers(0, 800, n), type=pa.int64()),
+            "v": pa.array(rng.uniform(0, 10, n)),
+        }
+    )
+    right = pa.table(
+        {
+            "k2": pa.array(np.arange(0, 1200, 2), type=pa.int64()),  # evens
+            "w": pa.array(np.arange(600) * 1.5),
+        }
+    )
+    c = ExecutionContext()
+    c.register_record_batches("l", left, n_partitions=4)
+    c.register_record_batches("r", right, n_partitions=3)
+    df = c.table("l").join(c.table("r"), ["k"], ["k2"], how="left")
+    phys = c.create_physical_plan(df.logical_plan())
+
+    def nodes(p):
+        yield p
+        for ch in p.children():
+            yield from nodes(ch)
+
+    join = next(x for x in nodes(phys) if isinstance(x, HashJoinExec))
+    assert join.partitioned
+    assert join.output_partitioning().partition_count() > 1
+    assert not any(isinstance(x, MergeExec) for x in nodes(join))
+
+    out = df.collect()
+    import pandas as pd
+
+    oracle = left.to_pandas().merge(
+        right.to_pandas(), left_on="k", right_on="k2", how="left"
+    )
+    assert out.num_rows == len(oracle)
+    got_w = sorted((x if x is not None else -1.0) for x in out.column("w").to_pylist())
+    exp_w = sorted(oracle["w"].fillna(-1.0).tolist())
+    assert got_w == exp_w
+    # unmatched rows (odd keys) survive exactly once
+    assert got_w.count(-1.0) == int(oracle["w"].isna().sum()) > 0
+
+
+def test_full_join_multi_partition():
+    """FULL join: unmatched rows from BOTH sides survive co-partitioning."""
+    import numpy as np
+
+    from ballista_tpu.engine import ExecutionContext
+
+    left = pa.table({"k": [1, 2, 3, 5, 7], "v": ["a", "b", "c", "e", "g"]})
+    right = pa.table({"k2": [2, 3, 4, 6], "w": [20, 30, 40, 60]})
+    c = ExecutionContext()
+    c.register_record_batches("l", left, n_partitions=3)
+    c.register_record_batches("r", right, n_partitions=2)
+    out = (
+        c.table("l")
+        .join(c.table("r"), ["k"], ["k2"], how="full")
+        .collect()
+    )
+    # 2,3 match; 1,5,7 left-only; 4,6 right-only
+    assert out.num_rows == 7
+    ks = out.column("k").to_pylist()
+    assert sorted(k for k in ks if k is not None) == [1, 2, 3, 5, 7]
+    ws = out.column("w").to_pylist()
+    assert sorted(w for w in ws if w is not None) == [20, 30, 40, 60]
