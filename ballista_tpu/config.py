@@ -23,6 +23,10 @@ BALLISTA_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
 # compression for materialized shuffle pieces: "" (none) | "zstd" | "lz4"
 BALLISTA_SHUFFLE_CODEC = "ballista.shuffle.codec"
 BALLISTA_DEVICE_CACHE = "ballista.tpu.device_cache"  # keep encoded columns resident in HBM
+# total bytes of cached device residency across stages; partitions beyond
+# the budget stream (upload, compute, free) instead of pinning — how SF=100
+# fact layouts run on a 16GB-HBM chip
+BALLISTA_TPU_HBM_BUDGET = "ballista.tpu.hbm_budget_bytes"
 BALLISTA_SCAN_CACHE = "ballista.scan.cache"  # host-side decoded-table cache (parquet)
 BALLISTA_SCAN_CACHE_CAP = "ballista.scan.cache_cap_bytes"
 # experimental per-operator device offload (filter/projection masks, PK-FK
@@ -54,6 +58,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_SHUFFLE_PARTITIONS: "16",
     BALLISTA_SHUFFLE_CODEC: "",
     BALLISTA_DEVICE_CACHE: "true",
+    BALLISTA_TPU_HBM_BUDGET: str(12 << 30),
     BALLISTA_SCAN_CACHE: "true",
     BALLISTA_SCAN_CACHE_CAP: str(4 << 30),
     BALLISTA_TPU_PER_OP: "false",
@@ -131,6 +136,9 @@ class BallistaConfig(Mapping[str, str]):
         if k not in ("layout", "pallas"):
             raise ValueError(f"unknown sorted kernel {k!r} (layout|pallas)")
         return k
+
+    def tpu_hbm_budget(self) -> int:
+        return int(self._settings[BALLISTA_TPU_HBM_BUDGET])
 
     def data_roots(self):
         """Directory allowlist for wire-plan scan paths; [] = unrestricted."""

@@ -770,9 +770,20 @@ class FactAggregateStage:
         if self._fact_step is None:
             self._fact_step = self._build_fact_step()
         if use_cache:
+            from ballista_tpu.ops.runtime import (
+                entry_device_bytes,
+                try_reserve_residency,
+            )
+
             # ballista.tpu.device_cache=false: recompute per query instead
-            # of pinning the [V, L1] tiles in HBM
-            self._prepared[partition] = ent
+            # of pinning the [V, L1] tiles in HBM. Cached entries also count
+            # against the global HBM budget; beyond it, stream per query.
+            if try_reserve_residency(
+                (id(self), partition),
+                entry_device_bytes(ent),
+                ctx.config.tpu_hbm_budget(),
+            ):
+                self._prepared[partition] = ent
         return ent
 
     # ------------------------------------------------------------------

@@ -114,6 +114,11 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     try:
         return stage.run(partition, ctx)
     except UnsupportedOnDevice:
+        # permanently declined: free its pinned device entries and their
+        # HBM-budget reservations before dropping the stage
+        from ballista_tpu.ops.runtime import release_stage_residency
+
+        release_stage_residency(stage)
         _stage_cache[key] = False
         return None
 

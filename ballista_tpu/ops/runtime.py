@@ -96,6 +96,79 @@ class ScanDictionaries:
         return self.dicts[index]
 
 
+# -- device residency accounting -------------------------------------------
+# One chip's HBM is shared by every cached stage; partitions that would push
+# the total past the configured budget stream per query instead of pinning.
+# First-come residency (hot partitions prepared first stay resident); a
+# stage invalidated by the kernel dispatcher releases its reservations.
+import threading
+
+_res_lock = threading.Lock()
+_resident_bytes = 0
+_reservations: dict = {}  # token -> bytes
+
+
+def entry_device_bytes(obj) -> int:
+    """Recursive nbytes of the DEVICE (jax) arrays inside a prepared cache
+    entry. Host-side metadata (numpy rank orders, arrow key values) rides in
+    the same dicts but does not occupy HBM, so it is not counted."""
+    try:
+        import jax
+
+        if isinstance(obj, jax.Array):
+            return int(obj.nbytes)
+    except ImportError:
+        pass
+    if isinstance(obj, dict):
+        return sum(entry_device_bytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(entry_device_bytes(v) for v in obj)
+    return 0
+
+
+def try_reserve_residency(token, nbytes: int, budget: int) -> bool:
+    """Atomically account nbytes against the global budget; False = stream.
+    token identifies the cache slot ((id(stage), partition)) so a racing
+    duplicate prepare of the same slot is not double-counted."""
+    global _resident_bytes
+    with _res_lock:
+        if token in _reservations:
+            return True
+        if _resident_bytes + nbytes > budget:
+            return False
+        _reservations[token] = nbytes
+        _resident_bytes += nbytes
+        return True
+
+
+def release_residency(token) -> None:
+    global _resident_bytes
+    with _res_lock:
+        _resident_bytes -= _reservations.pop(token, 0)
+
+
+def release_stage_residency(stage) -> None:
+    """Drop a stage's cached device entries and their reservations (the
+    dispatcher calls this when it permanently declines a stage)."""
+    for attr in ("_device_cache", "_prepared"):
+        cache = getattr(stage, attr, None)
+        if cache:
+            for p in list(cache):
+                release_residency((id(stage), p))
+            cache.clear()
+
+
+def resident_bytes() -> int:
+    return _resident_bytes
+
+
+def reset_residency() -> None:
+    global _resident_bytes
+    with _res_lock:
+        _resident_bytes = 0
+        _reservations.clear()
+
+
 def bucket_rows(n: int, minimum: int = 1024) -> int:
     """Pad row counts to power-of-two buckets to bound XLA recompilation."""
     b = minimum

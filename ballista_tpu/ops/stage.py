@@ -743,7 +743,19 @@ class FusedAggregateStage:
             except TooManyGroups:
                 prepared = self._prepare_partition_sorted(partition, ctx)
             if use_cache:
-                self._device_cache[partition] = prepared
+                from ballista_tpu.ops.runtime import (
+                    entry_device_bytes,
+                    try_reserve_residency,
+                )
+
+                # pin only within the HBM budget; partitions beyond it
+                # stream per query (how SF=100 fits a 16GB chip)
+                if try_reserve_residency(
+                    (id(self), partition),
+                    entry_device_bytes(prepared),
+                    ctx.config.tpu_hbm_budget(),
+                ):
+                    self._device_cache[partition] = prepared
 
         aux = [jnp.asarray(a) for a in self.compiler.build_aux()]
         if prepared["kind"] == "empty":

@@ -130,11 +130,13 @@ def gen_supplier(sf: float, rng: np.random.Generator) -> pa.Table:
     )
 
 
-def gen_part(sf: float, rng: np.random.Generator) -> pa.Table:
+def gen_part(sf: float, rng: np.random.Generator, lo: int = 0,
+             n: int = None) -> pa.Table:
     import pyarrow.compute as pc
 
-    n = max(1, int(200_000 * sf))
-    keys = np.arange(1, n + 1, dtype=np.int64)
+    if n is None:
+        n = max(1, int(200_000 * sf))
+    keys = np.arange(lo + 1, lo + n + 1, dtype=np.int64)
     name = pc.binary_join_element_wise(
         _take(COLORS, rng.integers(0, len(COLORS), n)),
         _take(COLORS, rng.integers(0, len(COLORS), n)),
@@ -168,11 +170,15 @@ def gen_part(sf: float, rng: np.random.Generator) -> pa.Table:
     )
 
 
-def gen_partsupp(sf: float, rng: np.random.Generator) -> pa.Table:
+def gen_partsupp(sf: float, rng: np.random.Generator, lo: int = 0,
+                 n: int = None) -> pa.Table:
+    # lo/n are in PART-key space (4 rows per part)
     n_part = max(1, int(200_000 * sf))
     n_supp = max(1, int(10_000 * sf))
-    pk = np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4)
-    i = np.tile(np.arange(4, dtype=np.int64), n_part)
+    if n is None:
+        lo, n = 0, n_part
+    pk = np.repeat(np.arange(lo + 1, lo + n + 1, dtype=np.int64), 4)
+    i = np.tile(np.arange(4, dtype=np.int64), n)
     sk = ((pk + i * (n_supp // 4 + 1)) % n_supp) + 1
     n = len(pk)
     return pa.table(
@@ -187,9 +193,11 @@ def gen_partsupp(sf: float, rng: np.random.Generator) -> pa.Table:
     )
 
 
-def gen_customer(sf: float, rng: np.random.Generator) -> pa.Table:
-    n = max(1, int(150_000 * sf))
-    keys = np.arange(1, n + 1, dtype=np.int64)
+def gen_customer(sf: float, rng: np.random.Generator, lo: int = 0,
+                 n: int = None) -> pa.Table:
+    if n is None:
+        n = max(1, int(150_000 * sf))
+    keys = np.arange(lo + 1, lo + n + 1, dtype=np.int64)
     nk = rng.integers(0, 25, n).astype(np.int64)
     return pa.table(
         {
@@ -206,10 +214,12 @@ def gen_customer(sf: float, rng: np.random.Generator) -> pa.Table:
     )
 
 
-def gen_orders(sf: float, rng: np.random.Generator) -> pa.Table:
-    n = max(1, int(1_500_000 * sf))
+def gen_orders(sf: float, rng: np.random.Generator, lo: int = 0,
+               n: int = None) -> pa.Table:
+    if n is None:
+        n = max(1, int(1_500_000 * sf))
     n_cust = max(1, int(150_000 * sf))
-    keys = np.arange(1, n + 1, dtype=np.int64)
+    keys = np.arange(lo + 1, lo + n + 1, dtype=np.int64)
     # dbgen: only 2/3 of customers have orders
     ck = (rng.integers(0, max(1, n_cust * 2 // 3), n) * 3 % n_cust) + 1
     odate = rng.integers(START, END - 121, n).astype(np.int32)
@@ -294,18 +304,74 @@ def write_partitioned(table: pa.Table, out_dir: str, name: str, parts: int) -> N
         pq.write_table(chunk, os.path.join(d, f"part-{p:03d}.parquet"))
 
 
+# per-chunk generation caps (keys per chunk): bound peak memory so SF=100
+# streams to parquet instead of materializing ~600M lineitem rows at once
+# (the reference's dbgen also streams, rust/benchmarks/tpch/tpch-gen.sh)
+_CHUNK_KEYS = {
+    "part": 4_000_000,
+    "partsupp": 1_000_000,  # part-key space: 4 rows per key
+    "customer": 4_000_000,
+    "orders": 2_000_000,  # ~4x lineitem rows ride along per chunk
+}
+
+
+def _chunked_write(out_dir, name, total, parts, seed, gen_chunk) -> None:
+    """Write `total` keys of table `name` as >=parts files, each generated
+    independently from rng([seed, tag, k]) so no chunk depends on another
+    (deterministic for a given seed regardless of chunk schedule)."""
+    d = os.path.join(out_dir, name)
+    os.makedirs(d, exist_ok=True)
+    files = max(1, min(parts, total))
+    cap = _CHUNK_KEYS[name]
+    files = max(files, -(-total // cap))
+    step = -(-total // files)
+    import zlib
+
+    tag = zlib.crc32(name.encode())  # stable across processes (hash() is not)
+    for k in range(files):
+        lo = k * step
+        n = min(step, total - lo)
+        if n <= 0:
+            break
+        rng = np.random.default_rng([seed, tag, k])
+        gen_chunk(rng, lo, n, os.path.join(d, f"part-{k:03d}.parquet"), k)
+
+
 def generate(out_dir: str, sf: float = 0.01, parts: int = 2, seed: int = 20260728) -> None:
     os.makedirs(out_dir, exist_ok=True)
     rng = np.random.default_rng(seed)
     write_partitioned(gen_region(), out_dir, "region", 1)
     write_partitioned(gen_nation(), out_dir, "nation", 1)
     write_partitioned(gen_supplier(sf, rng), out_dir, "supplier", 1)
-    write_partitioned(gen_part(sf, rng), out_dir, "part", parts)
-    write_partitioned(gen_partsupp(sf, rng), out_dir, "partsupp", parts)
-    write_partitioned(gen_customer(sf, rng), out_dir, "customer", parts)
-    orders = gen_orders(sf, rng)
-    write_partitioned(orders, out_dir, "orders", parts)
-    write_partitioned(gen_lineitem(sf, rng, orders), out_dir, "lineitem", parts)
+
+    _chunked_write(
+        out_dir, "part", max(1, int(200_000 * sf)), parts, seed,
+        lambda r, lo, n, path, k: pq.write_table(gen_part(sf, r, lo, n), path),
+    )
+    _chunked_write(
+        out_dir, "partsupp", max(1, int(200_000 * sf)), parts, seed,
+        lambda r, lo, n, path, k: pq.write_table(gen_partsupp(sf, r, lo, n), path),
+    )
+    _chunked_write(
+        out_dir, "customer", max(1, int(150_000 * sf)), parts, seed,
+        lambda r, lo, n, path, k: pq.write_table(gen_customer(sf, r, lo, n), path),
+    )
+
+    # orders + lineitem ride the same chunk (lineitem rows derive from the
+    # chunk's orders); each chunk lands as one parquet file per table
+    li_dir = os.path.join(out_dir, "lineitem")
+    os.makedirs(li_dir, exist_ok=True)
+
+    def orders_chunk(r, lo, n, path, k):
+        o = gen_orders(sf, r, lo, n)
+        pq.write_table(o, path)
+        pq.write_table(
+            gen_lineitem(sf, r, o), os.path.join(li_dir, f"part-{k:03d}.parquet")
+        )
+
+    _chunked_write(
+        out_dir, "orders", max(1, int(1_500_000 * sf)), parts, seed, orders_chunk
+    )
 
 
 def register_all(ctx, data_dir: str) -> None:

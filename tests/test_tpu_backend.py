@@ -154,3 +154,45 @@ def test_taxi_high_cardinality_groupby(tmp_path_factory):
         ctx.register_parquet("trips", f"{d}/trips")
         out[backend] = ctx.sql(TRIP_AGG_QUERY).collect().to_pandas()
     assert_close(out["cpu"], out["tpu"], rtol=1e-5)
+
+
+def test_hbm_budget_streams_beyond_cap(tpch_dir):
+    """Partitions past the residency budget stream per query instead of
+    pinning; results are identical either way (SF=100's path on a 16GB
+    chip). The budget is global across stages."""
+    from ballista_tpu.ops import kernels, runtime
+    from benchmarks.tpch.datagen import register_all
+
+    sql = (
+        "select l_returnflag, sum(l_quantity) as sq, count(*) as n "
+        "from lineitem group by l_returnflag order by l_returnflag"
+    )
+
+    def run_with_budget(budget):
+        kernels._stage_cache.clear()
+        runtime.reset_residency()
+        ctx = ExecutionContext(
+            BallistaConfig(
+                {
+                    "ballista.executor.backend": "tpu",
+                    "ballista.tpu.hbm_budget_bytes": str(budget),
+                }
+            )
+        )
+        register_all(ctx, tpch_dir)
+        out = ctx.sql(sql).collect()
+        from ballista_tpu.ops.stage import FusedAggregateStage
+
+        stages = [
+            s for s in kernels._stage_cache.values()
+            if isinstance(s, FusedAggregateStage)
+        ]
+        cached = sum(len(s._device_cache) for s in stages)
+        return out, cached, runtime.resident_bytes()
+
+    full, cached_full, rb_full = run_with_budget(12 << 30)
+    tiny, cached_tiny, rb_tiny = run_with_budget(1)
+    assert cached_full > 0 and rb_full > 0  # default: partitions pinned
+    assert cached_tiny == 0 and rb_tiny == 0  # budget 1 byte: all stream
+    assert full.to_pylist() == tiny.to_pylist()
+    runtime.reset_residency()
