@@ -28,6 +28,9 @@ _stage_cache: Dict[str, object] = {}
 # pins each cached stage's table source so its id() (part of the cache key
 # for memory scans) can never be recycled by a different object
 _stage_cache_pins: Dict[str, object] = {}
+# stable plan identity -> the latest full (mtime-bearing) cache key, so a
+# rewritten file's superseded entry can be evicted and its reservations freed
+_stage_latest: Dict[str, str] = {}
 _filter_cache: Dict[tuple, object] = {}
 _cache_configured = False
 
@@ -72,16 +75,20 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
             yield from leaves(c)
 
     parts = []
+    mtimes = []
     pinned = []
     for leaf in leaves(exec_node):
         if isinstance(leaf, MemoryScanExec):
             parts.append(str(id(leaf.source)))
             pinned.append(leaf.source)
         elif hasattr(leaf, "source") and hasattr(leaf.source, "files"):
-            # include file mtimes so a rewritten file invalidates the cached
-            # stage (and its device-resident columns)
-            parts.extend(
-                f"{f}:{os.path.getmtime(f) if os.path.exists(f) else 0}"
+            # file mtimes invalidate the cached stage (and its
+            # device-resident columns) when a file is rewritten; they live
+            # in a separate key component so the superseded entry can be
+            # found and its HBM reservations released
+            parts.extend(leaf.source.files)
+            mtimes.extend(
+                str(os.path.getmtime(f) if os.path.exists(f) else 0)
                 for f in leaf.source.files
             )
     # config flags participate in the key: a run-time decline under one
@@ -93,9 +100,22 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         f"sk={ctx.config.tpu_sorted_kernel()},"
         f"topk={getattr(exec_node, '_topk_pushdown', None)}"
     )
-    key = exec_node.display_indent() + "|" + ",".join(parts) + "|" + flags
+    stable = exec_node.display_indent() + "|" + ",".join(parts) + "|" + flags
+    key = stable + "|" + ",".join(mtimes)
     stage = _stage_cache.get(key)
     if stage is None:
+        # evict a superseded entry for the same stable plan (file rewritten:
+        # new mtimes) and release its HBM-budget reservations — otherwise a
+        # long-lived executor leaks budget until everything streams
+        old_key = _stage_latest.get(stable)
+        if old_key is not None and old_key != key:
+            old = _stage_cache.pop(old_key, None)
+            _stage_cache_pins.pop(old_key, None)
+            if old not in (None, False):
+                from ballista_tpu.ops.runtime import release_stage_residency
+
+                release_stage_residency(old)
+        _stage_latest[stable] = key
         try:
             from ballista_tpu.ops.factagg import FactAggregateStage
 

@@ -220,6 +220,15 @@ def _resolves_in(col: lx.Column, schema: pa.Schema) -> bool:
         return False
 
 
+def _expr_resolves(e: lx.Expr, schema: pa.Schema) -> bool:
+    """True when every column reference under e resolves against schema.
+    Walks the tree explicitly — data_type() can short-circuit (boolean
+    BinaryExprs return bool without resolving their children)."""
+    if isinstance(e, lx.Column):
+        return _resolves_in(e, schema)
+    return all(_expr_resolves(c, schema) for c in e.children())
+
+
 # ---------------------------------------------------------------------------
 # SelectPlanner
 # ---------------------------------------------------------------------------
@@ -456,7 +465,13 @@ class SelectPlanner:
             out_schema = plan.schema()
             sort_exprs = []
             mapping = getattr(self, "_order_mapping", {})
-            for oi in stmt.order_by:
+            # ORDER BY may reference input columns/exprs the SELECT list
+            # dropped (standard SQL): append them to the projection as
+            # hidden sort columns, sort, then strip. DISTINCT keeps the
+            # strict rule (hidden columns would change its semantics).
+            base_proj = plan if isinstance(plan, lp.Projection) else None
+            hidden: List[lx.Expr] = []
+            for hi, oi in enumerate(stmt.order_by):
                 e = oi.expr
                 # ordinal reference: ORDER BY 1
                 if isinstance(e, lx.Literal) and isinstance(e.value, int):
@@ -468,15 +483,28 @@ class SelectPlanner:
                                   f.name.split(".")[0] if "." in f.name else None)
                 else:
                     e = rewrite_expr(e, mapping)
-                    # prefer resolving against projection output; aggregate
-                    # exprs were rewritten to output columns already
-                    if isinstance(e, lx.Column) and not _resolves_in(e, out_schema):
-                        raise SqlError(
-                            f"ORDER BY column {e.flat_name()!r} not in output"
-                        )
+                    if not _expr_resolves(e, out_schema):
+                        if base_proj is not None and _expr_resolves(
+                            e, base_proj.input.schema()
+                        ):
+                            name = f"__sort_{hi}"
+                            hidden.append(lx.Alias(e, name))
+                            e = lx.Column(name)
+                        else:
+                            raise SqlError(
+                                f"ORDER BY expression {e!s} not in output"
+                            )
                 nf = oi.nulls_first if oi.nulls_first is not None else False
                 sort_exprs.append(lx.SortExpr(e, oi.ascending, nf))
-            plan = lp.Sort(plan, sort_exprs)
+            if hidden:
+                visible = [f.name for f in out_schema]
+                plan = lp.Projection(base_proj.input, list(base_proj.exprs) + hidden)
+                plan = lp.Sort(plan, sort_exprs)
+                plan = lp.Projection(
+                    plan, [lx.Alias(lx.Column(n), n) for n in visible]
+                )
+            else:
+                plan = lp.Sort(plan, sort_exprs)
         if stmt.limit is not None:
             plan = lp.Limit(plan, stmt.limit, stmt.offset)
         return plan

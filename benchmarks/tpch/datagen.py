@@ -316,25 +316,37 @@ _CHUNK_KEYS = {
 
 
 def _chunked_write(out_dir, name, total, parts, seed, gen_chunk) -> None:
-    """Write `total` keys of table `name` as >=parts files, each generated
-    independently from rng([seed, tag, k]) so no chunk depends on another
-    (deterministic for a given seed regardless of chunk schedule)."""
-    d = os.path.join(out_dir, name)
-    os.makedirs(d, exist_ok=True)
-    files = max(1, min(parts, total))
-    cap = _CHUNK_KEYS[name]
-    files = max(files, -(-total // cap))
-    step = -(-total // files)
+    """Write `total` keys of table `name`, generated in fixed-size chunks
+    seeded from rng([seed, tag, k]). Chunking depends ONLY on the table's
+    cap — never on `parts` — so the DATA is deterministic for a given
+    (seed, sf); `parts` only controls the file layout (generated chunks are
+    sliced into sub-files when fewer chunks than parts exist)."""
     import zlib
 
+    d = os.path.join(out_dir, name)
+    os.makedirs(d, exist_ok=True)
+    cap = _CHUNK_KEYS[name]
+    n_chunks = max(1, -(-total // cap))
+    step = -(-total // n_chunks)
+    subsplit = max(1, -(-max(1, parts) // n_chunks))
     tag = zlib.crc32(name.encode())  # stable across processes (hash() is not)
-    for k in range(files):
+    for k in range(n_chunks):
         lo = k * step
         n = min(step, total - lo)
         if n <= 0:
             break
         rng = np.random.default_rng([seed, tag, k])
-        gen_chunk(rng, lo, n, os.path.join(d, f"part-{k:03d}.parquet"), k)
+        gen_chunk(rng, lo, n, d, k, subsplit)
+
+
+def _write_split(table: pa.Table, d: str, k: int, subsplit: int) -> None:
+    rows = table.num_rows
+    ss = min(subsplit, max(1, rows))
+    sstep = -(-rows // ss)
+    for s in range(ss):
+        chunk = table.slice(s * sstep, sstep)
+        if chunk.num_rows:
+            pq.write_table(chunk, os.path.join(d, f"part-{k:03d}-{s:02d}.parquet"))
 
 
 def generate(out_dir: str, sf: float = 0.01, parts: int = 2, seed: int = 20260728) -> None:
@@ -346,28 +358,26 @@ def generate(out_dir: str, sf: float = 0.01, parts: int = 2, seed: int = 2026072
 
     _chunked_write(
         out_dir, "part", max(1, int(200_000 * sf)), parts, seed,
-        lambda r, lo, n, path, k: pq.write_table(gen_part(sf, r, lo, n), path),
+        lambda r, lo, n, d, k, ss: _write_split(gen_part(sf, r, lo, n), d, k, ss),
     )
     _chunked_write(
         out_dir, "partsupp", max(1, int(200_000 * sf)), parts, seed,
-        lambda r, lo, n, path, k: pq.write_table(gen_partsupp(sf, r, lo, n), path),
+        lambda r, lo, n, d, k, ss: _write_split(gen_partsupp(sf, r, lo, n), d, k, ss),
     )
     _chunked_write(
         out_dir, "customer", max(1, int(150_000 * sf)), parts, seed,
-        lambda r, lo, n, path, k: pq.write_table(gen_customer(sf, r, lo, n), path),
+        lambda r, lo, n, d, k, ss: _write_split(gen_customer(sf, r, lo, n), d, k, ss),
     )
 
     # orders + lineitem ride the same chunk (lineitem rows derive from the
-    # chunk's orders); each chunk lands as one parquet file per table
+    # chunk's orders)
     li_dir = os.path.join(out_dir, "lineitem")
     os.makedirs(li_dir, exist_ok=True)
 
-    def orders_chunk(r, lo, n, path, k):
+    def orders_chunk(r, lo, n, d, k, ss):
         o = gen_orders(sf, r, lo, n)
-        pq.write_table(o, path)
-        pq.write_table(
-            gen_lineitem(sf, r, o), os.path.join(li_dir, f"part-{k:03d}.parquet")
-        )
+        _write_split(o, d, k, ss)
+        _write_split(gen_lineitem(sf, r, o), li_dir, k, ss)
 
     _chunked_write(
         out_dir, "orders", max(1, int(1_500_000 * sf)), parts, seed, orders_chunk

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Regenerate the checked-in protobuf bindings from the wire contract
+# (the role the reference's build.rs/tonic-build codegen plays,
+# rust/core/build.rs:15-23). Run after editing proto/ballista.proto.
+set -euo pipefail
+cd "$(dirname "$0")/../ballista_tpu/proto"
+protoc --python_out=. ballista.proto
+python - <<'PY'
+import sys
+sys.path.insert(0, "../..")
+from ballista_tpu.proto import ballista_pb2 as pb
+n = pb.PhysicalPlanNode()
+print("regenerated ballista_pb2.py; smoke import ok:", bool(n.DESCRIPTOR))
+PY

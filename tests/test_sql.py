@@ -218,3 +218,30 @@ def test_in_list_null_member_three_valued():
         c.sql("select x from t where x in (5, null)")
         .collect().column("x").to_pylist() == [5]
     )
+
+
+def test_order_by_non_selected_column():
+    """Standard SQL: ORDER BY may use input columns/expressions the SELECT
+    list dropped — planned as hidden sort columns, sorted, then stripped."""
+    import pyarrow as pa
+
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.errors import BallistaError
+
+    c = ExecutionContext()
+    t = pa.table({"a": [3, 1, 2], "b": ["x", "z", "y"], "v": [1.0, 2.0, 3.0]})
+    c.register_record_batches("tob", t)
+    out = c.sql("select b from tob order by a").collect()
+    assert out.column("b").to_pylist() == ["z", "y", "x"]
+    assert out.schema.names == ["b"]
+    out = c.sql("select b from tob order by a + v desc").collect()
+    assert out.column("b").to_pylist() == ["y", "x", "z"]
+    # aggregate: order by a group key that was not selected
+    out = c.sql("select sum(v) as s from tob group by a order by a").collect()
+    assert out.column("s").to_pylist() == [2.0, 3.0, 1.0]
+    assert out.schema.names == ["s"]
+    # DISTINCT keeps the strict rule (hidden columns would change it)
+    import pytest as _pytest
+
+    with _pytest.raises(BallistaError, match="not in output"):
+        c.sql("select distinct b from tob order by a").collect()
