@@ -772,18 +772,20 @@ class FactAggregateStage:
         if use_cache:
             from ballista_tpu.ops.runtime import (
                 entry_device_bytes,
-                try_reserve_residency,
+                reserve_and_pin,
             )
 
             # ballista.tpu.device_cache=false: recompute per query instead
             # of pinning the [V, L1] tiles in HBM. Cached entries also count
             # against the global HBM budget; beyond it, stream per query.
-            if try_reserve_residency(
-                (id(self), partition),
+            reserve_and_pin(
+                self,
+                partition,
+                ent,
+                self._prepared,
                 entry_device_bytes(ent),
                 ctx.config.tpu_hbm_budget(),
-            ):
-                self._prepared[partition] = ent
+            )
         return ent
 
     # ------------------------------------------------------------------

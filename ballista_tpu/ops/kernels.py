@@ -31,6 +31,11 @@ _stage_cache_pins: Dict[str, object] = {}
 # stable plan identity -> the latest full (mtime-bearing) cache key, so a
 # rewritten file's superseded entry can be evicted and its reservations freed
 _stage_latest: Dict[str, str] = {}
+# executor task threads run concurrently: lookup/evict/insert must be one
+# atomic section or two threads can each build (and pin) the same stage
+import threading as _threading
+
+_stage_cache_lock = _threading.Lock()
 _filter_cache: Dict[tuple, object] = {}
 _cache_configured = False
 
@@ -102,33 +107,41 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     )
     stable = exec_node.display_indent() + "|" + ",".join(parts) + "|" + flags
     key = stable + "|" + ",".join(mtimes)
-    stage = _stage_cache.get(key)
-    if stage is None:
-        # evict a superseded entry for the same stable plan (file rewritten:
-        # new mtimes) and release its HBM-budget reservations — otherwise a
-        # long-lived executor leaks budget until everything streams
-        old_key = _stage_latest.get(stable)
-        if old_key is not None and old_key != key:
-            old = _stage_cache.pop(old_key, None)
-            _stage_cache_pins.pop(old_key, None)
-            if old not in (None, False):
-                from ballista_tpu.ops.runtime import release_stage_residency
+    with _stage_cache_lock:
+        stage = _stage_cache.get(key)
+        if stage is None:
+            # evict a superseded entry for the same stable plan (file
+            # rewritten: new mtimes) and release its HBM-budget reservations
+            # — otherwise a long-lived executor leaks budget until
+            # everything streams. release marks the old stage retired, so a
+            # task thread still inside its run() cannot re-reserve.
+            old_key = _stage_latest.get(stable)
+            if old_key is not None and old_key != key:
+                old = _stage_cache.pop(old_key, None)
+                _stage_cache_pins.pop(old_key, None)
+                if old not in (None, False):
+                    from ballista_tpu.ops.runtime import release_stage_residency
 
-                release_stage_residency(old)
-        _stage_latest[stable] = key
+                    release_stage_residency(old)
+            _stage_latest[stable] = key
+    if stage is None:
+        # build OUTSIDE the lock — a slow stage build must not block cache
+        # hits for unrelated queries. First insert wins on a racing build.
         try:
             from ballista_tpu.ops.factagg import FactAggregateStage
 
             # aggregate over a join: try the fact-side pushdown first
-            stage = FactAggregateStage.try_build(exec_node)
-            if stage is None:
-                stage = FusedAggregateStage(exec_node)
+            built = FactAggregateStage.try_build(exec_node)
+            if built is None:
+                built = FusedAggregateStage(exec_node)
         except UnsupportedOnDevice:
-            _stage_cache[key] = False
-            _stage_cache_pins[key] = pinned
-            return None
-        _stage_cache[key] = stage
-        _stage_cache_pins[key] = pinned
+            built = False
+        with _stage_cache_lock:
+            stage = _stage_cache.get(key)
+            if stage is None:
+                _stage_cache[key] = built
+                _stage_cache_pins[key] = pinned
+                stage = built
     if stage is False:
         return None
     try:
@@ -139,7 +152,8 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         from ballista_tpu.ops.runtime import release_stage_residency
 
         release_stage_residency(stage)
-        _stage_cache[key] = False
+        with _stage_cache_lock:
+            _stage_cache[key] = False
         return None
 
 

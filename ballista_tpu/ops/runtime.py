@@ -126,18 +126,28 @@ def entry_device_bytes(obj) -> int:
     return 0
 
 
-def try_reserve_residency(token, nbytes: int, budget: int) -> bool:
-    """Atomically account nbytes against the global budget; False = stream.
-    token identifies the cache slot ((id(stage), partition)) so a racing
-    duplicate prepare of the same slot is not double-counted."""
+def reserve_and_pin(stage, partition: int, entry, cache: dict, nbytes: int, budget: int) -> bool:
+    """Atomically reserve HBM budget AND insert the prepared entry into the
+    stage's cache dict, refusing retired stages.
+
+    A task thread may still be inside stage.run() when another thread
+    evicts that stage (superseded mtimes) and releases its reservations.
+    The retired check, the reservation, and the dict insert all happen
+    under the same lock release_stage_residency holds for the flag write
+    and the cache sweep — so there is no window where a reservation exists
+    for a partition the sweep cannot see (which would leak budget
+    permanently once the stage is unreachable)."""
     global _resident_bytes
+    token = (id(stage), partition)
     with _res_lock:
-        if token in _reservations:
-            return True
-        if _resident_bytes + nbytes > budget:
+        if getattr(stage, "_retired", False):
             return False
-        _reservations[token] = nbytes
-        _resident_bytes += nbytes
+        if token not in _reservations:
+            if _resident_bytes + nbytes > budget:
+                return False
+            _reservations[token] = nbytes
+            _resident_bytes += nbytes
+        cache[partition] = entry
         return True
 
 
@@ -149,13 +159,18 @@ def release_residency(token) -> None:
 
 def release_stage_residency(stage) -> None:
     """Drop a stage's cached device entries and their reservations (the
-    dispatcher calls this when it permanently declines a stage)."""
-    for attr in ("_device_cache", "_prepared"):
-        cache = getattr(stage, attr, None)
-        if cache:
-            for p in list(cache):
-                release_residency((id(stage), p))
-            cache.clear()
+    dispatcher calls this when it permanently declines or evicts a stage).
+    Runs entirely under the residency lock: the retired flag and the cache
+    sweep are one atomic step against reserve_and_pin."""
+    global _resident_bytes
+    with _res_lock:
+        stage._retired = True
+        for attr in ("_device_cache", "_prepared"):
+            cache = getattr(stage, attr, None)
+            if cache:
+                for p in list(cache):
+                    _resident_bytes -= _reservations.pop((id(stage), p), 0)
+                cache.clear()
 
 
 def resident_bytes() -> int:

@@ -745,17 +745,19 @@ class FusedAggregateStage:
             if use_cache:
                 from ballista_tpu.ops.runtime import (
                     entry_device_bytes,
-                    try_reserve_residency,
+                    reserve_and_pin,
                 )
 
                 # pin only within the HBM budget; partitions beyond it
                 # stream per query (how SF=100 fits a 16GB chip)
-                if try_reserve_residency(
-                    (id(self), partition),
+                reserve_and_pin(
+                    self,
+                    partition,
+                    prepared,
+                    self._device_cache,
                     entry_device_bytes(prepared),
                     ctx.config.tpu_hbm_budget(),
-                ):
-                    self._device_cache[partition] = prepared
+                )
 
         aux = [jnp.asarray(a) for a in self.compiler.build_aux()]
         if prepared["kind"] == "empty":

@@ -63,6 +63,10 @@ class SchedulerServer:
         # tasks running on executors whose lease lapsed are rescheduled this
         # often (the reference loses such work permanently)
         self.lost_task_check_interval = 5.0
+        # GetFileMetadata walks globs and reads parquet footers; cap how many
+        # RPC worker threads it may hold at once so a burst of large metadata
+        # requests can never starve PollWork heartbeats of workers
+        self._file_meta_slots = threading.BoundedSemaphore(4)
 
     # -- RPC implementations ------------------------------------------------
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None) -> pb.ExecuteQueryResult:
@@ -203,21 +207,30 @@ class SchedulerServer:
         # parquet only, like the reference (lib.rs:184-222)
         if request.file_type.lower() != "parquet":
             raise ValueError("GetFileMetadata supports parquet only")
-        from ballista_tpu.datasource import ParquetTableSource
-        from ballista_tpu.executor.confine import (
-            check_scan_files,
-            check_scan_roots_path,
-        )
+        # fail fast: a blocked waiter would itself occupy an RPC worker
+        # thread, defeating the purpose of the cap
+        if not self._file_meta_slots.acquire(blocking=False):
+            raise RuntimeError(
+                "GetFileMetadata: too many concurrent metadata requests; retry"
+            )
+        try:
+            from ballista_tpu.datasource import ParquetTableSource
+            from ballista_tpu.executor.confine import (
+                check_scan_files,
+                check_scan_roots_path,
+            )
 
-        # same allowlist as ExecuteQuery: this RPC reads parquet footers of
-        # client-named host paths
-        check_scan_roots_path(request.path, self.config.data_roots())
-        src = ParquetTableSource(request.path)
-        check_scan_files(src.files, self.config.data_roots())
-        return pb.GetFileMetadataResult(
-            schema_ipc=schema_to_ipc(src.schema()),
-            num_partitions=src.num_partitions(),
-        )
+            # same allowlist as ExecuteQuery: this RPC reads parquet footers of
+            # client-named host paths
+            check_scan_roots_path(request.path, self.config.data_roots())
+            src = ParquetTableSource(request.path)
+            check_scan_files(src.files, self.config.data_roots())
+            return pb.GetFileMetadataResult(
+                schema_ipc=schema_to_ipc(src.schema()),
+                num_partitions=src.num_partitions(),
+            )
+        finally:
+            self._file_meta_slots.release()
 
 
 def serve(

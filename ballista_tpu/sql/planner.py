@@ -67,6 +67,60 @@ def conjoin(exprs: Sequence[lx.Expr]) -> Optional[lx.Expr]:
     return out
 
 
+def factor_or_common(e: lx.Expr) -> List[lx.Expr]:
+    """(A and X) or (A and Y) -> [A, (X or Y)].
+
+    Lifts conjuncts common to every OR branch to the top level, so equi-join
+    keys hidden inside each disjunct become visible to join planning. q19's
+    WHERE is the canonical shape: all three OR branches repeat
+    `p_partkey = l_partkey` (+ shipmode/shipinstruct filters); without
+    factoring the whole predicate lands post-join and the join degrades to a
+    cartesian product (8.7 TiB of pairs at SF=1). Same rewrite DataFusion
+    applies before join-key extraction. Returns the conjunct list (the input
+    unchanged, as a 1-list, when nothing factors).
+    """
+    if not (isinstance(e, lx.BinaryExpr) and e.op == "or"):
+        return [e]
+
+    branches: List[lx.Expr] = []
+
+    def flat_or(x: lx.Expr) -> None:
+        if isinstance(x, lx.BinaryExpr) and x.op == "or":
+            flat_or(x.left)
+            flat_or(x.right)
+        else:
+            branches.append(x)
+
+    flat_or(e)
+    branch_conjs = [split_conjuncts(b) for b in branches]
+    # conjuncts present (by structural string) in every branch
+    keyed = [{str(c): c for c in bc} for bc in branch_conjs]
+    common_keys = set(keyed[0])
+    for k in keyed[1:]:
+        common_keys &= set(k)
+    if not common_keys:
+        return [e]
+    common = [c for key, c in keyed[0].items() if key in common_keys]
+    residuals: List[Optional[lx.Expr]] = []
+    for bc in branch_conjs:
+        seen: Set[str] = set()
+        rest = []
+        for c in bc:
+            # drop only ONE occurrence per common key (duplicates stay)
+            if str(c) in common_keys and str(c) not in seen:
+                seen.add(str(c))
+                continue
+            rest.append(c)
+        residuals.append(conjoin(rest))
+    if any(r is None for r in residuals):
+        # some branch was exactly the common part: A or (A and X) = A
+        return common
+    disj: lx.Expr = residuals[0]
+    for r in residuals[1:]:
+        disj = lx.BinaryExpr(disj, "or", r)
+    return common + [disj]
+
+
 def collect_columns(e: lx.Expr, out: List[lx.Column]) -> None:
     if isinstance(e, lx.Column):
         out.append(e)
@@ -605,7 +659,9 @@ class SelectPlanner:
         for item in stmt.from_items:
             rels.extend(self._plan_from_item(item))
 
-        conjuncts = split_conjuncts(stmt.where)
+        conjuncts = [
+            f for c in split_conjuncts(stmt.where) for f in factor_or_common(c)
+        ]
         subquery_conjuncts = [c for c in conjuncts if contains_subquery(c)]
         plain = [c for c in conjuncts if not contains_subquery(c)]
 

@@ -59,6 +59,39 @@ class BallistaEngine:
         return self.ctx.sql(sql).collect()
 
 
+# -- engine: pandas oracles (all 22 queries) -------------------------------
+
+
+class PandasOracleEngine:
+    """The shared pandas oracles (benchmarks/tpch/oracles.py) as a
+    comparison engine — covers the full 22-query list, matching the breadth
+    of the reference's Spark harness (Main.scala:45-195)."""
+
+    def __init__(self, data: str) -> None:
+        self.dir = pathlib.Path(data)
+        self._tables = None
+
+    def _load(self):
+        if self._tables is None:
+            names = ["lineitem", "orders", "customer", "supplier", "nation",
+                     "region", "part", "partsupp"]
+            self._tables = {}
+            for n in names:
+                files = sorted((self.dir / n).glob("*.parquet"))
+                self._tables[n] = pa.concat_tables(
+                    pq.read_table(f) for f in files
+                ).to_pandas()
+        return self._tables
+
+    def run(self, name: str) -> Optional[pa.Table]:
+        from benchmarks.tpch.oracles import ORACLES
+
+        fn = ORACLES.get(name)
+        if fn is None:
+            return None
+        return pa.Table.from_pandas(fn(self._load()), preserve_index=False)
+
+
 # -- engine: raw pyarrow (independent Arrow C++ baseline) ------------------
 
 
@@ -269,12 +302,16 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--data", default=str(REPO / ".bench_cache" / "tpch_sf1.0"))
     ap.add_argument("--queries", nargs="+",
-                    default=["q1", "q3", "q5", "q6", "q10", "q12"])
+                    default=["q1", "q3", "q5", "q6", "q10", "q12"],
+                    help="query names, or 'all' for the full 22-query list")
     ap.add_argument("--iterations", type=int, default=3)
-    ap.add_argument("--engines", nargs="+", default=["tpu", "host", "pyarrow"])
+    ap.add_argument("--engines", nargs="+",
+                    default=["tpu", "host", "pyarrow", "pandas"])
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero when engines disagree (CI mode)")
     args = ap.parse_args()
+    if args.queries == ["all"]:
+        args.queries = [f"q{i}" for i in range(1, 23)]
     mismatches = 0
 
     engines: Dict[str, object] = {}
@@ -283,6 +320,8 @@ def main() -> None:
             engines[e] = BallistaEngine(args.data, e)
         elif e == "pyarrow":
             engines[e] = PyArrowEngine(args.data)
+        elif e == "pandas":
+            engines[e] = PandasOracleEngine(args.data)
 
     rows = []
     for q in args.queries:
@@ -326,7 +365,7 @@ def main() -> None:
             elif (
                 vals is not None
                 and base_vals is not None
-                and not np.allclose(vals, base_vals, rtol=1e-3)
+                and not np.allclose(vals, base_vals, rtol=1e-3, equal_nan=True)
             ):
                 mismatches += 1
                 print(f"WARNING: {q}: {name} values disagree with {base_name}",

@@ -350,7 +350,18 @@ def _write_split(table: pa.Table, d: str, k: int, subsplit: int) -> None:
 
 
 def generate(out_dir: str, sf: float = 0.01, parts: int = 2, seed: int = 20260728) -> None:
+    import shutil
+
     os.makedirs(out_dir, exist_ok=True)
+    # start clean: scans glob every *.parquet under a table dir, so files
+    # surviving from an interrupted or older-layout run would silently
+    # duplicate rows in the regenerated dataset
+    marker = os.path.join(out_dir, "_SUCCESS")
+    if os.path.exists(marker):
+        os.remove(marker)
+    for t in ("region", "nation", "supplier", "part", "partsupp",
+              "customer", "orders", "lineitem"):
+        shutil.rmtree(os.path.join(out_dir, t), ignore_errors=True)
     rng = np.random.default_rng(seed)
     write_partitioned(gen_region(), out_dir, "region", 1)
     write_partitioned(gen_nation(), out_dir, "nation", 1)
@@ -382,6 +393,14 @@ def generate(out_dir: str, sf: float = 0.01, parts: int = 2, seed: int = 2026072
     _chunked_write(
         out_dir, "orders", max(1, int(1_500_000 * sf)), parts, seed, orders_chunk
     )
+    # completeness marker: generation streams for hours at SF=100; consumers
+    # (bench.py ensure_data) must not mistake an interrupted run for a dataset
+    with open(os.path.join(out_dir, "_SUCCESS"), "w") as f:
+        f.write(f"sf={sf} parts={parts} seed={seed}\n")
+
+
+def is_complete(out_dir: str) -> bool:
+    return os.path.exists(os.path.join(out_dir, "_SUCCESS"))
 
 
 def register_all(ctx, data_dir: str) -> None:

@@ -238,3 +238,49 @@ def test_scheduler_enforces_data_roots(tmp_path):
         c.close()
     finally:
         cluster.shutdown()
+
+
+def test_get_file_metadata_direct_and_bounded(tmp_path):
+    """GetFileMetadata reads footers of allowlisted paths and is capped by a
+    worker-slot semaphore so metadata bursts cannot starve PollWork
+    (ref lib.rs:184-222 runs it on the shared RPC runtime)."""
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.proto import ballista_pb2 as pb
+    from ballista_tpu.scheduler.server import SchedulerServer
+    from ballista_tpu.serde.arrow import schema_from_ipc
+
+    root = tmp_path / "data"
+    root.mkdir()
+    pq.write_table(pa.table({"x": [1.0, 2.0], "y": [3, 4]}), str(root / "t.parquet"))
+    srv = SchedulerServer(
+        config=BallistaConfig({"ballista.executor.data_roots": str(root)})
+    )
+    res = srv.GetFileMetadata(
+        pb.GetFileMetadataParams(path=str(root / "t.parquet"), file_type="parquet")
+    )
+    assert res.num_partitions == 1
+    assert schema_from_ipc(res.schema_ipc).names == ["x", "y"]
+
+    # out-of-root path refused before any footer read
+    outside = tmp_path / "secret.parquet"
+    pq.write_table(pa.table({"z": [9]}), str(outside))
+    with pytest.raises(Exception, match="data roots"):
+        srv.GetFileMetadata(
+            pb.GetFileMetadataParams(path=str(outside), file_type="parquet")
+        )
+
+    # all slots held -> the RPC fails fast instead of tying up a worker
+    for _ in range(4):
+        assert srv._file_meta_slots.acquire(blocking=False)
+    try:
+        with pytest.raises(RuntimeError, match="too many concurrent"):
+            srv.GetFileMetadata(
+                pb.GetFileMetadataParams(
+                    path=str(root / "t.parquet"), file_type="parquet"
+                )
+            )
+    finally:
+        for _ in range(4):
+            srv._file_meta_slots.release()
