@@ -38,6 +38,19 @@ BALLISTA_TPU_FUSE_VOLATILE = "ballista.tpu.fuse_volatile_sources"  # aggregate o
 # distributed planner: collapse Partial->hash shuffle->Final aggregations
 # into ONE mesh program (shard_map + psum over ICI, parallel/spmd_stage.py)
 BALLISTA_TPU_SPMD = "ballista.tpu.spmd_stages"
+# plan multi-partition aggregations as ONE SINGLE-mode aggregate over merged
+# input instead of Partial/Final. On a single chip the partial/final split
+# buys no parallelism and costs one d2h readback of partial states PER
+# partition (~65ms latency + bandwidth each through the relay); coalescing
+# restores the top-k readback pushdown (SINGLE-mode only) and makes the
+# whole aggregation one dispatch + one small readback. "auto" = on when the
+# backend is tpu and SPMD stage fusion is off (the distributed scheduler
+# and the mesh dryrun keep the exchange shape).
+BALLISTA_TPU_COALESCE_AGG = "ballista.tpu.coalesce_aggregates"
+# byte cap (sum of leaf scan file sizes) above which coalescing is skipped:
+# one driven partition materializes the whole chain, so huge inputs keep the
+# Partial/Final split and stream file-by-file within the HBM budget
+BALLISTA_TPU_COALESCE_MAX = "ballista.tpu.coalesce_max_bytes"
 # high-cardinality sorted aggregation kernel: "layout" (chunked-segment
 # tiles, default) | "pallas" (MXU one-hot matmul with RMW DMA windows,
 # sum/count/avg only — measured slower on v5e, kept selectable)
@@ -65,6 +78,8 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_DEVICE_JOIN: "false",
     BALLISTA_TPU_FUSE_VOLATILE: "false",
     BALLISTA_TPU_SPMD: "false",
+    BALLISTA_TPU_COALESCE_AGG: "auto",
+    BALLISTA_TPU_COALESCE_MAX: str(6 << 30),
     BALLISTA_TPU_SORTED_KERNEL: "layout",
     BALLISTA_DATA_ROOTS: "",
 }
@@ -130,6 +145,15 @@ class BallistaConfig(Mapping[str, str]):
 
     def tpu_spmd(self) -> bool:
         return self._settings[BALLISTA_TPU_SPMD].lower() in ("1", "true", "yes")
+
+    def tpu_coalesce_aggregates(self) -> bool:
+        v = self._settings[BALLISTA_TPU_COALESCE_AGG].strip().lower()
+        if v == "auto":
+            return self.backend() == "tpu" and not self.tpu_spmd()
+        return v in ("1", "true", "yes")
+
+    def tpu_coalesce_max_bytes(self) -> int:
+        return int(self._settings[BALLISTA_TPU_COALESCE_MAX])
 
     def tpu_sorted_kernel(self) -> str:
         k = self._settings[BALLISTA_TPU_SORTED_KERNEL].strip().lower()

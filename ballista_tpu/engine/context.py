@@ -93,7 +93,11 @@ class ExecutionContext:
         return optimize_plan(plan)
 
     def create_physical_plan(self, plan: lp.LogicalPlan) -> ExecutionPlan:
-        planner = PhysicalPlanner(batch_size=self.config.batch_size())
+        planner = PhysicalPlanner(
+            batch_size=self.config.batch_size(),
+            coalesce_aggregates=self.config.tpu_coalesce_aggregates(),
+            coalesce_max_bytes=self.config.tpu_coalesce_max_bytes(),
+        )
         return planner.create_physical_plan(self.optimize(plan))
 
     def collect(self, plan: lp.LogicalPlan) -> pa.Table:

@@ -65,6 +65,7 @@ from ballista_tpu.physical import expr as px
 from ballista_tpu.physical.basic import (
     CoalesceBatchesExec,
     FilterExec,
+    MergeExec,
     ProjectionExec,
 )
 
@@ -128,7 +129,14 @@ class FactAggregateStage:
         # -- walk down to the join ------------------------------------
         node = agg.input
         stack: List[Tuple[str, object]] = []
-        while isinstance(node, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
+        # partitions the framework will actually drive this aggregate with
+        # (1 for SINGLE mode / over MergeExec). The fact scan's own count
+        # can differ — e.g. a single-partition probe side with a
+        # multi-partition fact build side — so fact reads stripe over the
+        # driven count (inner.scan_stride below); a 1:1 partition map there
+        # would silently aggregate only a fraction of the fact rows.
+        n_driven = agg.input.output_partitioning().partition_count()
+        while isinstance(node, (FilterExec, ProjectionExec, CoalesceBatchesExec, MergeExec)):
             if isinstance(node, FilterExec):
                 stack.append(("filter", node.predicate))
             elif isinstance(node, ProjectionExec):
@@ -317,6 +325,11 @@ class FactAggregateStage:
         # chunk partials must BE group partials (member mask / top-k index
         # group space); widen L1 to the longest key run
         self.inner.sorted_cover_max = True
+        n_fact = self.fact_plan.output_partitioning().partition_count()
+        if n_driven != n_fact:
+            # stripe fact partitions over the driven partitions so every
+            # fact row is read exactly once (n_driven=1: read them all)
+            self.inner.scan_stride = n_driven
         if not self.inner.cacheable:
             raise UnsupportedOnDevice("fact side not cacheable")
         if self.secondary is not None:
@@ -340,7 +353,7 @@ class FactAggregateStage:
         self.partial_schema = FusedAggregateStage._partial_schema(agg)
         # planner-provided Sort+Limit epilogue (physical/planner.py)
         self.topk = getattr(agg, "_topk_pushdown", None)
-        self.partitions = self.fact_plan.output_partitioning().partition_count()
+        self.partitions = n_driven
         if self.topk is not None and (
             self.partitions != 1
             or self.aggs[self.topk["agg_index"]].fn != "sum"

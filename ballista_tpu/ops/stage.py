@@ -34,7 +34,12 @@ from ballista_tpu.ops.runtime import (
     pad_to,
 )
 from ballista_tpu.physical import expr as px
-from ballista_tpu.physical.basic import CoalesceBatchesExec, FilterExec, ProjectionExec
+from ballista_tpu.physical.basic import (
+    CoalesceBatchesExec,
+    FilterExec,
+    MergeExec,
+    ProjectionExec,
+)
 from ballista_tpu.physical.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
 
 _SCAN_TYPES = (CsvScanExec, ParquetScanExec, MemoryScanExec)
@@ -181,7 +186,14 @@ class FusedAggregateStage:
         # join-under-aggregate still gets device aggregation.
         node = agg.input
         stack: List[Tuple[str, object]] = []
-        while isinstance(node, (FilterExec, ProjectionExec, CoalesceBatchesExec)):
+        # scan_stride: when set to N, this stage's logical partition p reads
+        # scan partitions p, p+N, p+2N, ... — used when the partition count
+        # the framework drives (aggregate input partitioning) differs from
+        # the scan's own count. Crossing a MergeExec (row-transparent; the
+        # coalesced SINGLE-mode plan) means ONE driven partition covers
+        # every scan partition: stride 1.
+        self.scan_stride: Optional[int] = None
+        while isinstance(node, (FilterExec, ProjectionExec, CoalesceBatchesExec, MergeExec)):
             if isinstance(node, FilterExec):
                 stack.append(("filter", node.predicate))
                 node = node.input
@@ -189,6 +201,8 @@ class FusedAggregateStage:
                 stack.append(("project", node.exprs))
                 node = node.input
             else:
+                if isinstance(node, MergeExec):
+                    self.scan_stride = 1
                 node = node.input
         self.scan = node
         # device columns stay resident only for file-backed scans (stable
@@ -501,7 +515,14 @@ class FusedAggregateStage:
     def _scan_batches(self, partition: int, ctx):
         """Read the scan partition for device consumption. Parquet fast path:
         eager read_table with dictionary columns (dictionary pages map
-        straight to codes — ~10x faster than the streaming dictionary read)."""
+        straight to codes — ~10x faster than the streaming dictionary read).
+        With scan_stride=N, driven partition p covers scan partitions
+        p, p+N, p+2N, ... (N=1: SINGLE mode over MergeExec reads them all)."""
+        if self.scan_stride is not None:
+            total = self.scan.output_partitioning().partition_count()
+            parts = range(partition, total, self.scan_stride)
+        else:
+            parts = [partition]
         if isinstance(self.scan, ParquetScanExec):
             import pyarrow.parquet as pq
 
@@ -511,14 +532,16 @@ class FusedAggregateStage:
                 for f in self.scan.schema()
                 if pa.types.is_string(f.type) or pa.types.is_large_string(f.type)
             ]
-            table = pq.read_table(
-                self.scan.source.files[partition],
-                columns=names,
-                read_dictionary=strings,
-            ).combine_chunks()
-            yield from table.to_batches(max_chunksize=ctx.batch_size)
+            for p in parts:
+                table = pq.read_table(
+                    self.scan.source.files[p],
+                    columns=names,
+                    read_dictionary=strings,
+                ).combine_chunks()
+                yield from table.to_batches(max_chunksize=ctx.batch_size)
             return
-        yield from self.scan.execute(partition, ctx)
+        for p in parts:
+            yield from self.scan.execute(p, ctx)
 
     def _check_int_ranges(self, batch_cols, n: int) -> None:
         """Integer sums accumulate in int32 on device; decline when a masked
@@ -767,18 +790,22 @@ class FusedAggregateStage:
         if prepared["kind"] == "pallas_sorted":
             return self._run_pallas_sorted(prepared, aux)
 
-        # dispatch all batches asynchronously, then materialize — device
-        # compute and d2h of batch i overlap dispatch of batch i+1
+        # dispatch all batches asynchronously, then materialize same-shaped
+        # outputs in one stacked d2h transfer — per-batch fetches would pay
+        # the relay round-trip k times (runtime.fetch_arrays)
+        from ballista_tpu.ops.runtime import fetch_arrays
+
         pending = []
         for ent in prepared["entries"]:
             stacked_dev = self._step(
                 ent["seg_bucket"], ent["cols"], aux, ent["codes"], ent["row_valid"]
             )
             pending.append((stacked_dev, ent))
+        fetched = fetch_arrays([dev for dev, _ in pending])
 
         partial_tables: List[pa.Table] = []
-        for stacked_dev, ent in pending:
-            rows = self._decode_stacked(np.asarray(stacked_dev))
+        for stacked_np, (_, ent) in zip(fetched, pending):
+            rows = self._decode_stacked(stacked_np)
             n_groups = ent["n_groups"]
             counts_np = rows[0][:n_groups]
             outputs = [o[:n_groups] for o in rows[1:]]

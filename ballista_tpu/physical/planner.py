@@ -44,8 +44,40 @@ from ballista_tpu.physical.union import UnionExec
 
 
 class PhysicalPlanner:
-    def __init__(self, batch_size: int = 32768) -> None:
+    def __init__(
+        self,
+        batch_size: int = 32768,
+        coalesce_aggregates: bool = False,
+        coalesce_max_bytes: int = 6 << 30,
+    ) -> None:
         self.batch_size = batch_size
+        # single-chip device execution: plan aggregations SINGLE over merged
+        # input so the device stage runs once with the top-k pushdown
+        # applicable, instead of a per-partition Partial each paying a d2h
+        # readback of its full partial state (config.BALLISTA_TPU_COALESCE_AGG)
+        self.coalesce_aggregates = coalesce_aggregates
+        self.coalesce_max_bytes = coalesce_max_bytes
+
+    @staticmethod
+    def _leaf_scan_bytes(node: ExecutionPlan) -> int:
+        """On-disk bytes of the file-backed leaf scans under a subtree
+        (compressed parquet under-counts the decoded size, so the coalesce
+        cap should stay well below physical memory limits)."""
+        import os
+
+        if isinstance(node, (ParquetScanExec, CsvScanExec)):
+            try:
+                return sum(
+                    os.path.getsize(f) for f in node.source.files
+                    if os.path.exists(f)
+                )
+            except OSError:
+                return 0
+        if isinstance(node, MemoryScanExec):
+            return sum(
+                b.nbytes for part in node.source.partitions for b in part
+            )
+        return sum(PhysicalPlanner._leaf_scan_bytes(c) for c in node.children())
 
     def create_physical_plan(self, plan: lp.LogicalPlan) -> ExecutionPlan:
         p = self._plan(plan)
@@ -276,9 +308,17 @@ class PhysicalPlanner:
             )
 
         single_partition = input.output_partitioning().partition_count() == 1
-        if any_distinct or single_partition:
+        coalesce = self.coalesce_aggregates and (
+            self._leaf_scan_bytes(input) <= self.coalesce_max_bytes
+        )
+        if any_distinct or single_partition or coalesce:
             # DISTINCT aggregates need global visibility; single-partition
-            # inputs skip the pointless partial/final split
+            # inputs skip the pointless partial/final split; coalesced mode
+            # (single-chip TPU) trades the split for one device dispatch.
+            # Coalescing is size-guarded: one driven partition materializes
+            # the whole input chain, so past the byte cap the Partial/Final
+            # split stays (streams file-by-file within the HBM budget —
+            # how SF=100 fits a 16GB chip).
             merged = input if single_partition else MergeExec(input)
             return HashAggregateExec(AggregateMode.SINGLE, merged, group_exprs, funcs)
 

@@ -141,7 +141,11 @@ class SchedulerServer:
             self.state.save_job_metadata(job_id, failed)
 
     def _plan_job(self, job_id: str, plan, config) -> None:
-        ctx = ExecutionContext(config)
+        from ballista_tpu.config import BALLISTA_TPU_COALESCE_AGG
+
+        # distributed jobs keep the Partial/exchange/Final shape: the stage
+        # split parallelizes across executors, and the SPMD fuse needs it
+        ctx = ExecutionContext(config.with_setting(BALLISTA_TPU_COALESCE_AGG, "false"))
         physical = ctx.create_physical_plan(plan)
         stages = DistributedPlanner(config).plan_query_stages(job_id, physical)
         for stage in stages:
