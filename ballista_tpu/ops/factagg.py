@@ -496,6 +496,12 @@ class FactAggregateStage:
         filtering) or when group values are not unique per class."""
         if self._sec_cache is not None:
             return self._sec_cache
+        with self.inner._prepare_lock:
+            return self._sec_side_locked(ctx)
+
+    def _sec_side_locked(self, ctx) -> dict:
+        if self._sec_cache is not None:
+            return self._sec_cache
         from ballista_tpu.physical.plan import collect_all
 
         sec = self.secondary
@@ -741,7 +747,15 @@ class FactAggregateStage:
 
     # ------------------------------------------------------------------
     def _dim_side(self, ctx) -> dict:
-        """Execute (+ cache, if enabled) the dim side; build key->row index."""
+        """Execute (+ cache, if enabled) the dim side; build key->row index.
+        Serialized with the stage's prepare lock: concurrent first-touch
+        partitions must not each collect the dim plan."""
+        if self._dim_cache is not None:
+            return self._dim_cache
+        with self.inner._prepare_lock:
+            return self._dim_side_locked(ctx)
+
+    def _dim_side_locked(self, ctx) -> dict:
         if self._dim_cache is not None:
             return self._dim_cache
         from ballista_tpu.physical.plan import collect_all
@@ -764,6 +778,16 @@ class FactAggregateStage:
         return out
 
     def _prepare(self, partition: int, ctx) -> dict:
+        ent = self._prepared.get(partition)
+        if ent is not None:
+            return ent
+        # concurrent executor task threads: serialize prepare (shared
+        # growing dictionaries / compiled-step slots), same as the inner
+        # stage's own lock
+        with self.inner._prepare_lock:
+            return self._prepare_locked(partition, ctx)
+
+    def _prepare_locked(self, partition: int, ctx) -> dict:
         ent = self._prepared.get(partition)
         if ent is not None:
             return ent

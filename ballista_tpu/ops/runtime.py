@@ -28,12 +28,25 @@ class UnsupportedOnDevice(Exception):
 
 
 class ColumnDictionary:
-    """Growing per-column dictionary mapping values -> stable int32 codes."""
+    """Growing per-column dictionary mapping values -> stable int32 codes.
+
+    Thread-safe: executor task threads can run different partitions of one
+    cached stage concurrently, and both prepare-time encode() and
+    aux-build-time code_of() extend the dictionary (read-modify-write on
+    `values`); an unguarded interleaving would silently re-assign codes
+    already baked into pinned device tiles."""
 
     def __init__(self) -> None:
+        import threading
+
         self.values: Optional[pa.Array] = None  # accumulated distinct values
+        self._lock = threading.Lock()
 
     def encode(self, arr: pa.Array) -> np.ndarray:
+        with self._lock:
+            return self._encode(arr)
+
+    def _encode(self, arr: pa.Array) -> np.ndarray:
         """Encode an Arrow array to codes against this dictionary, extending
         it with novel values. Nulls -> -1."""
         if isinstance(arr, pa.ChunkedArray):
@@ -71,14 +84,15 @@ class ColumnDictionary:
 
     def code_of(self, value) -> int:
         """Code for a literal, extending the dictionary so it always exists."""
-        if self.values is None:
-            self.values = pa.array([value])
-            return 0
-        idx = pc.index_in(pa.scalar(value, type=self.values.type), value_set=self.values)
-        if idx.as_py() is None:
-            self.values = pa.concat_arrays([self.values, pa.array([value], type=self.values.type)])
-            return len(self.values) - 1
-        return int(idx.as_py())
+        with self._lock:
+            if self.values is None:
+                self.values = pa.array([value])
+                return 0
+            idx = pc.index_in(pa.scalar(value, type=self.values.type), value_set=self.values)
+            if idx.as_py() is None:
+                self.values = pa.concat_arrays([self.values, pa.array([value], type=self.values.type)])
+                return len(self.values) - 1
+            return int(idx.as_py())
 
     def __len__(self) -> int:
         return 0 if self.values is None else len(self.values)

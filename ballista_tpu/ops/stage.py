@@ -267,6 +267,12 @@ class FusedAggregateStage:
         self._step = self._build_step()
         self._sorted_step = None  # built on first high-cardinality partition
         self._device_cache: Dict[int, dict] = {}
+        # executor task threads can run different partitions of one cached
+        # stage concurrently; prepare mutates shared state (the growing
+        # ColumnDictionary, compiled-step slots), so it is serialized
+        import threading
+
+        self._prepare_lock = threading.Lock()
         # name -> fn(row-space npcols dict) -> np row array; materialized as
         # [V, L1] tiles alongside the scan columns on the sorted path
         # (FactAggregateStage derives static mapped columns this way)
@@ -760,27 +766,30 @@ class FusedAggregateStage:
             raise UnsupportedOnDevice("volatile row source (enable ballista.tpu.fuse_volatile_sources)")
         prepared = self._device_cache.get(partition) if use_cache else None
         if prepared is None:
-            try:
-                prepared = {"kind": "batches",
-                            "entries": self._prepare_partition(partition, ctx)}
-            except TooManyGroups:
-                prepared = self._prepare_partition_sorted(partition, ctx)
-            if use_cache:
-                from ballista_tpu.ops.runtime import (
-                    entry_device_bytes,
-                    reserve_and_pin,
-                )
+            with self._prepare_lock:
+                prepared = self._device_cache.get(partition) if use_cache else None
+                if prepared is None:
+                    try:
+                        prepared = {"kind": "batches",
+                                    "entries": self._prepare_partition(partition, ctx)}
+                    except TooManyGroups:
+                        prepared = self._prepare_partition_sorted(partition, ctx)
+                    if use_cache:
+                        from ballista_tpu.ops.runtime import (
+                            entry_device_bytes,
+                            reserve_and_pin,
+                        )
 
-                # pin only within the HBM budget; partitions beyond it
-                # stream per query (how SF=100 fits a 16GB chip)
-                reserve_and_pin(
-                    self,
-                    partition,
-                    prepared,
-                    self._device_cache,
-                    entry_device_bytes(prepared),
-                    ctx.config.tpu_hbm_budget(),
-                )
+                        # pin only within the HBM budget; partitions beyond
+                        # it stream per query (how SF=100 fits a 16GB chip)
+                        reserve_and_pin(
+                            self,
+                            partition,
+                            prepared,
+                            self._device_cache,
+                            entry_device_bytes(prepared),
+                            ctx.config.tpu_hbm_budget(),
+                        )
 
         aux = [jnp.asarray(a) for a in self.compiler.build_aux()]
         if prepared["kind"] == "empty":

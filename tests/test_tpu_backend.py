@@ -255,3 +255,71 @@ def test_coalesced_factagg_topk(tpch_dir):
     ]
     assert ran, "device fact-agg stage did not run (silent host fallback)"
     assert any(s.topk is not None and s.inner.scan_stride == 1 for s in ran)
+
+
+def test_concurrent_partition_runs_share_stage_safely(tpch_dir):
+    """Executor task threads run different partitions of one cached stage
+    concurrently; prepare (growing dictionaries, compiled-step slots) is
+    serialized per stage, so concurrent runs must produce exactly the
+    sequential results."""
+    import threading
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.physical.aggregate import AggregateMode, HashAggregateExec
+    from ballista_tpu.physical.plan import TaskContext, collect_partition
+    from benchmarks.tpch.datagen import register_all
+
+    # keep the Partial/Final split so several driven partitions exist
+    cfg = BallistaConfig({
+        "ballista.executor.backend": "tpu",
+        "ballista.tpu.coalesce_aggregates": "false",
+    })
+    ctx = ExecutionContext(cfg)
+    register_all(ctx, tpch_dir)
+    df = ctx.sql(
+        "select l_returnflag, sum(l_quantity) as s, count(*) as c "
+        "from lineitem group by l_returnflag"
+    )
+    phys = ctx.create_physical_plan(df.logical_plan())
+
+    def find_partial(n):
+        if isinstance(n, HashAggregateExec) and n.mode == AggregateMode.PARTIAL:
+            return n
+        for ch in n.children():
+            r = find_partial(ch)
+            if r is not None:
+                return r
+        return None
+
+    partial = find_partial(phys)
+    assert partial is not None
+    nparts = partial.output_partitioning().partition_count()
+    assert nparts >= 2
+    tctx = TaskContext(config=cfg)
+    sequential = [collect_partition(partial, p, tctx) for p in range(nparts)]
+
+    from ballista_tpu.ops import kernels
+
+    kernels._stage_cache.clear()
+    kernels._stage_cache_pins.clear()
+    kernels._stage_latest.clear()
+    results = [None] * nparts
+    errors = []
+
+    def work(p):
+        try:
+            results[p] = collect_partition(partial, p, tctx)
+        except Exception as e:  # noqa: BLE001
+            errors.append((p, e))
+
+    threads = [threading.Thread(target=work, args=(p,)) for p in range(nparts)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for p in range(nparts):
+        a = sequential[p].to_pandas().sort_values("l_returnflag").reset_index(drop=True)
+        b = results[p].to_pandas().sort_values("l_returnflag").reset_index(drop=True)
+        assert (a == b).all().all(), p
