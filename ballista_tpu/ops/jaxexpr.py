@@ -103,8 +103,17 @@ class ExprCompiler:
             return CompiledValue("num", lambda cols, aux, f=inner.fn: -f(cols, aux))
 
         if isinstance(e, px.IsNullExpr):
-            # device columns are null-free by construction (runtime rejects
-            # nullable batches)
+            inner = self.compile(e.expr)
+            if inner.kind == "code":
+                # dictionary-encoded string columns carry nulls as -1 codes
+                # (ColumnDictionary.encode) — IS NULL is a code test
+                def isnull_code_fn(cols, aux, f=inner.fn, neg=e.negated):
+                    r = f(cols, aux) < 0
+                    return jnp.logical_not(r) if neg else r
+
+                return CompiledValue("bool", isnull_code_fn)
+            # numeric/date/bool device columns are null-free by construction
+            # (column_to_numpy rejects nullable batches)
             const = bool(e.negated)  # IS NOT NULL -> True, IS NULL -> False
 
             def isnull_fn(cols, aux, c=const):
@@ -149,13 +158,17 @@ class ExprCompiler:
                 values = list(e.values)
 
                 def in_table() -> np.ndarray:
-                    n = max(1, len(d))
                     from ballista_tpu.ops.runtime import bucket_rows
 
+                    # snapshot once under the dictionary lock: a concurrent
+                    # encode() may grow it between reads (torn len/values)
+                    with d._lock:
+                        vals = d.values
+                    n = max(1, 0 if vals is None else len(vals))
                     table = np.zeros(bucket_rows(n, 16), dtype=np.bool_)
-                    if d.values is not None:
-                        member = pc.is_in(d.values, value_set=pa.array(values))
-                        table[: len(d)] = member.to_numpy(zero_copy_only=False)
+                    if vals is not None:
+                        member = pc.is_in(vals, value_set=pa.array(values))
+                        table[: len(vals)] = member.to_numpy(zero_copy_only=False)
                     return table
 
                 slot = self._add_aux(in_table)
@@ -306,11 +319,14 @@ class ExprCompiler:
         def like_table(d=d, pattern=pattern) -> np.ndarray:
             from ballista_tpu.ops.runtime import bucket_rows
 
-            n = max(1, len(d))
+            # snapshot once under the dictionary lock (see in_table)
+            with d._lock:
+                vals = d.values
+            n = max(1, 0 if vals is None else len(vals))
             table = np.zeros(bucket_rows(n, 16), dtype=np.bool_)
-            if d.values is not None:
-                m = pc.match_like(d.values, pattern)
-                table[: len(d)] = pc.fill_null(m, False).to_numpy(zero_copy_only=False)
+            if vals is not None:
+                m = pc.match_like(vals, pattern)
+                table[: len(vals)] = pc.fill_null(m, False).to_numpy(zero_copy_only=False)
             return table
 
         slot = self._add_aux(like_table)

@@ -239,6 +239,11 @@ class HashAggregateExec(ExecutionPlan):
                 s = agg_arrays[idxs[0]]
                 c = agg_arrays[idxs[1]]
                 out_arrays.append(pc.divide(pc.cast(s, pa.float64()), pc.cast(c, pa.float64())))
+            elif a.fn == "count":
+                # COUNT is never NULL: merging zero partial states (a global
+                # aggregate whose input had no rows) must finalize to 0, but
+                # pc.sum over an empty state column yields null
+                out_arrays.append(pc.fill_null(agg_arrays[idxs[0]], 0))
             else:
                 out_arrays.append(agg_arrays[idxs[0]])
         return _cast_to_schema(out_arrays, self._schema)

@@ -465,12 +465,13 @@ def test_fact_partitions_differ_from_driven_partitions(tmp_path):
     want = full.groupby("cust").amount.sum().sort_index()
     topw = full.groupby("cust").amount.sum().nlargest(5)
 
-    from ballista_tpu.ops import kernels
+    from ballista_tpu.ops import kernels, runtime
     from ballista_tpu.ops.factagg import FactAggregateStage
 
     kernels._stage_cache.clear()
     kernels._stage_cache_pins.clear()
     kernels._stage_latest.clear()
+    runtime.reset_residency()
     for dim, probe_parts in (("cust", 1), ("cust8", 8)):
         for backend in ("cpu", "tpu"):
             ctx = ExecutionContext(
