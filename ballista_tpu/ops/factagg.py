@@ -555,13 +555,13 @@ class FactAggregateStage:
         import jax.numpy as jnp
 
         inner = self.inner
-        filter_fns = inner.filter_fns
+        filter_masks = inner.filter_masks
 
         @jax.jit
         def step_sec(cols, aux, pad, m_tiles, p_rank, allowed):
             mask0 = pad
-            for f in filter_fns:
-                mask0 = jnp.logical_and(mask0, f.fn(cols, aux))
+            for fm in filter_masks:
+                mask0 = jnp.logical_and(mask0, fm(cols, aux))
             outs = []
             for g in range(allowed.shape[0]):
                 a = allowed[g]

@@ -28,12 +28,33 @@ EvalFn = Callable[[Dict[int, "jnp.ndarray"], List["jnp.ndarray"]], "jnp.ndarray"
 
 
 class CompiledValue:
+    """A lowered expression: fn computes the value; null_fn (when set)
+    computes the rows where the SQL value is NULL — three-valued logic
+    over dictionary codes, where -1 marks a NULL string. Predicates with
+    null_fn carry an UNDEFINED fn value on null rows; consumers must mask
+    (predicate_fn collapses to WHERE semantics: NULL -> excluded)."""
+
     def __init__(self, kind: str, fn: EvalFn,
-                 dictionary: Optional[ColumnDictionary] = None) -> None:
+                 dictionary: Optional[ColumnDictionary] = None,
+                 null_fn: Optional[EvalFn] = None) -> None:
         assert kind in ("num", "bool", "code")
         self.kind = kind
         self.fn = fn
         self.dictionary = dictionary
+        self.null_fn = null_fn
+
+
+def predicate_fn(cv: CompiledValue) -> EvalFn:
+    """WHERE-clause collapse of a compiled boolean: rows whose predicate is
+    NULL are excluded (SQL three-valued logic)."""
+    if cv.null_fn is None:
+        return cv.fn
+    import jax.numpy as jnp
+
+    def collapsed(cols, aux, v=cv.fn, n=cv.null_fn):
+        return jnp.logical_and(v(cols, aux), jnp.logical_not(n(cols, aux)))
+
+    return collapsed
 
 
 class ExprCompiler:
@@ -94,8 +115,12 @@ class ExprCompiler:
 
         if isinstance(e, px.NotExpr):
             inner = self.compile(e.expr)
+            # Kleene NOT: value flips, NULL stays NULL (NOT over a code
+            # predicate must not turn excluded NULL rows into included ones)
             return CompiledValue(
-                "bool", lambda cols, aux, f=inner.fn: jnp.logical_not(f(cols, aux))
+                "bool",
+                lambda cols, aux, f=inner.fn: jnp.logical_not(f(cols, aux)),
+                null_fn=inner.null_fn,
             )
 
         if isinstance(e, px.NegativeExpr):
@@ -160,10 +185,9 @@ class ExprCompiler:
                 def in_table() -> np.ndarray:
                     from ballista_tpu.ops.runtime import bucket_rows
 
-                    # snapshot once under the dictionary lock: a concurrent
-                    # encode() may grow it between reads (torn len/values)
-                    with d._lock:
-                        vals = d.values
+                    # one consistent view: a concurrent encode() may grow
+                    # the dictionary between torn len/values reads
+                    vals = d.snapshot()
                     n = max(1, 0 if vals is None else len(vals))
                     table = np.zeros(bucket_rows(n, 16), dtype=np.bool_)
                     if vals is not None:
@@ -177,7 +201,11 @@ class ExprCompiler:
                     r = aux[s][vf(cols, aux)]
                     return jnp.logical_not(r) if neg else r
 
-                return CompiledValue("bool", inlist_code_fn)
+                def inlist_null(cols, aux, vf=v.fn):
+                    # NULL IN / NOT IN a non-empty literal list is NULL
+                    return vf(cols, aux) < 0
+
+                return CompiledValue("bool", inlist_code_fn, null_fn=inlist_null)
             # numeric IN list -> chained equality
             consts = [self.compile(px.LiteralExpr(x, pa.float64() if isinstance(x, float) else pa.int64())) for x in e.values]
 
@@ -207,7 +235,8 @@ class ExprCompiler:
                     else jnp.asarray(np.float32(0))
                 )
                 for cw, ct in reversed(arms):
-                    out = jnp.where(cw.fn(cols, aux), ct.fn(cols, aux), out)
+                    # a NULL condition does not match its arm (3VL)
+                    out = jnp.where(predicate_fn(cw)(cols, aux), ct.fn(cols, aux), out)
                 return out
 
             kind = arms[0][1].kind
@@ -246,8 +275,34 @@ class ExprCompiler:
             l = self.compile(e.left)
             r = self.compile(e.right)
             jop = jnp.logical_and if op == "and" else jnp.logical_or
+            if l.null_fn is None and r.null_fn is None:
+                return CompiledValue(
+                    "bool", lambda cols, aux, lf=l.fn, rf=r.fn, j=jop: j(lf(cols, aux), rf(cols, aux))
+                )
+
+            # Kleene: AND is NULL unless a side is definitely FALSE; OR is
+            # NULL unless a side is definitely TRUE
+            def null3(cols, aux, l=l, r=r, is_and=(op == "and")):
+                f = jnp.asarray(False)
+                ln = l.null_fn(cols, aux) if l.null_fn else f
+                rn = r.null_fn(cols, aux) if r.null_fn else f
+                lv, rv = l.fn(cols, aux), r.fn(cols, aux)
+                if is_and:
+                    decided = jnp.logical_or(
+                        jnp.logical_and(jnp.logical_not(lv), jnp.logical_not(ln)),
+                        jnp.logical_and(jnp.logical_not(rv), jnp.logical_not(rn)),
+                    )
+                else:
+                    decided = jnp.logical_or(
+                        jnp.logical_and(lv, jnp.logical_not(ln)),
+                        jnp.logical_and(rv, jnp.logical_not(rn)),
+                    )
+                return jnp.logical_and(jnp.logical_or(ln, rn), jnp.logical_not(decided))
+
             return CompiledValue(
-                "bool", lambda cols, aux, lf=l.fn, rf=r.fn, j=jop: j(lf(cols, aux), rf(cols, aux))
+                "bool",
+                lambda cols, aux, lf=l.fn, rf=r.fn, j=jop: j(lf(cols, aux), rf(cols, aux)),
+                null_fn=null3,
             )
         l = self.compile(e.left)
         r = self.compile(e.right)
@@ -255,9 +310,15 @@ class ExprCompiler:
             if l.dictionary is not r.dictionary:
                 raise UnsupportedOnDevice("code comparison across dictionaries")
             fn = (lambda a, b: a == b) if op == "eq" else (lambda a, b: a != b)
-            return CompiledValue(
-                "bool", lambda cols, aux, lf=l.fn, rf=r.fn, f=fn: f(lf(cols, aux), rf(cols, aux))
-            )
+
+            def codecmp_fn(cols, aux, lf=l.fn, rf=r.fn, f=fn):
+                return f(lf(cols, aux), rf(cols, aux))
+
+            def codecmp_null(cols, aux, lf=l.fn, rf=r.fn):
+                # -1 codes are NULLs: NULL = / <> anything is NULL
+                return jnp.logical_or(lf(cols, aux) < 0, rf(cols, aux) < 0)
+
+            return CompiledValue("bool", codecmp_fn, null_fn=codecmp_null)
         if l.kind == "code" or r.kind == "code":
             raise UnsupportedOnDevice(f"string operands for {op}")
         cmps = {
@@ -313,15 +374,18 @@ class ExprCompiler:
                 r = f(cols, aux) == aux[s]
                 return jnp.logical_not(r) if neg else r
 
-            return CompiledValue("bool", eq_fn)
+            # NULL (= code -1) compares as NULL, under = and <> alike
+            def eq_null(cols, aux, f=cv.fn):
+                return f(cols, aux) < 0
+
+            return CompiledValue("bool", eq_fn, null_fn=eq_null)
 
         # LIKE via host-computed match table over the dictionary
         def like_table(d=d, pattern=pattern) -> np.ndarray:
             from ballista_tpu.ops.runtime import bucket_rows
 
-            # snapshot once under the dictionary lock (see in_table)
-            with d._lock:
-                vals = d.values
+            # one consistent view (see in_table)
+            vals = d.snapshot()
             n = max(1, 0 if vals is None else len(vals))
             table = np.zeros(bucket_rows(n, 16), dtype=np.bool_)
             if vals is not None:
@@ -332,10 +396,16 @@ class ExprCompiler:
         slot = self._add_aux(like_table)
 
         def like_fn(cols, aux, f=cv.fn, s=slot, neg=(op == "not_like")):
+            # the -1 gather wraps to the table's last entry; null rows are
+            # UNDEFINED here and masked by null_fn at the consumer
             r = aux[s][f(cols, aux)]
             return jnp.logical_not(r) if neg else r
 
-        return CompiledValue("bool", like_fn)
+        def like_null(cols, aux, f=cv.fn):
+            # NULL LIKE / NOT LIKE is NULL
+            return f(cols, aux) < 0
+
+        return CompiledValue("bool", like_fn, null_fn=like_null)
 
     # ------------------------------------------------------------------
     def _compile_function(self, e: px.ScalarFunctionExpr) -> CompiledValue:
@@ -378,8 +448,45 @@ class ExprCompiler:
                 )
             raise UnsupportedOnDevice(f"extract {pname}")
         if fn == "coalesce":
-            # null-free device path: first argument wins
-            return self.compile(e.args[0])
+            first = None
+            for a in e.args:
+                if isinstance(a, px.LiteralExpr) and isinstance(a.value, str):
+                    # string-literal fallback: needs the first code arg's dict
+                    if first is None or first.kind != "code":
+                        raise UnsupportedOnDevice("coalesce string literal first")
+                    d = first.dictionary
+                    slot = self._add_aux(
+                        lambda d=d, v=a.value: np.asarray(d.code_of(v), dtype=np.int32)
+                    )
+
+                    def coalesce_lit_fn(cols, aux, f=first.fn, nf=first.null_fn, s=slot):
+                        c = f(cols, aux)
+                        return jnp.where(c >= 0, c, aux[s])
+
+                    return CompiledValue("code", coalesce_lit_fn, first.dictionary)
+                cv = self.compile(a)
+                if first is None:
+                    first = cv
+                    if cv.kind != "code":
+                        # numeric/bool device columns are null-free: first
+                        # argument wins outright
+                        return cv
+                    continue
+                if cv.kind != "code" or cv.dictionary is not first.dictionary:
+                    raise UnsupportedOnDevice("coalesce across dictionaries")
+
+                def coalesce_fn(cols, aux, f=first.fn, g=cv.fn):
+                    c = f(cols, aux)
+                    return jnp.where(c >= 0, c, g(cols, aux))
+
+                def coalesce_null(cols, aux, f=first.fn, g=cv.fn):
+                    return jnp.logical_and(f(cols, aux) < 0, g(cols, aux) < 0)
+
+                first = CompiledValue("code", coalesce_fn, first.dictionary,
+                                      null_fn=coalesce_null)
+            if first is None:
+                raise UnsupportedOnDevice("empty coalesce")
+            return first
         raise UnsupportedOnDevice(f"scalar function {fn}")
 
 

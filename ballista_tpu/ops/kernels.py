@@ -172,9 +172,13 @@ def _compile_predicate(predicate, schema: pa.Schema):
             raise UnsupportedOnDevice("non-boolean predicate")
         import jax
 
+        from ballista_tpu.ops.jaxexpr import predicate_fn
+
+        mask_fn = predicate_fn(cv)  # WHERE collapse: NULL -> excluded
+
         @jax.jit
         def run(cols, aux):
-            return cv.fn(cols, aux)
+            return mask_fn(cols, aux)
 
         hit = (compiler, run)
     except UnsupportedOnDevice:

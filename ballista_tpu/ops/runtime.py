@@ -58,10 +58,13 @@ class ColumnDictionary:
         if isinstance(d, pa.ChunkedArray):
             d = d.combine_chunks()
         local_values = d.dictionary
-        local_codes = d.indices.to_numpy(zero_copy_only=False).astype(np.int64)
-        if d.indices.null_count:
-            mask = d.indices.is_valid().to_numpy(zero_copy_only=False)
-            local_codes = np.where(mask, local_codes, -1)
+        # nulls -> -1 BEFORE the numpy conversion (a null-carrying indices
+        # array converts via float NaN, whose int cast is undefined)
+        local_codes = (
+            pc.fill_null(d.indices, -1)
+            .to_numpy(zero_copy_only=False)
+            .astype(np.int64)
+        )
         if self.values is None:
             self.values = local_values
             remap = np.arange(len(local_values), dtype=np.int64)
@@ -81,6 +84,13 @@ class ColumnDictionary:
             remap = idx_np.astype(np.int64)
         out = np.where(local_codes >= 0, remap[np.maximum(local_codes, 0)], -1)
         return out.astype(np.int32)
+
+    def snapshot(self) -> Optional[pa.Array]:
+        """Consistent point-in-time view of the accumulated values (a
+        concurrent encode may grow the dictionary; callers must not read
+        `values` twice)."""
+        with self._lock:
+            return self.values
 
     def code_of(self, value) -> int:
         """Code for a literal, extending the dictionary so it always exists."""
@@ -256,14 +266,21 @@ _INT32_MAX = 2**31 - 1
 def column_to_numpy(
     arr: pa.Array, dtype: pa.DataType, dictionary: Optional[ColumnDictionary]
 ) -> np.ndarray:
-    """Lower one Arrow column to a device-ready numpy array (no nulls)."""
+    """Lower one Arrow column to a device-ready numpy array.
+
+    String columns tolerate nulls: they ride as -1 dictionary codes, and
+    every compiled code predicate (eq/neq/LIKE/IN/IS NULL) applies SQL
+    three-valued logic to code -1. Group keys are guarded separately
+    (_group_codes declines null keys host-side) and code-typed aggregate
+    inputs decline at compile, so predicates are the only device consumers.
+    Numeric/date/bool columns with nulls decline (no null representation)."""
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
-    if arr.null_count:
-        raise UnsupportedOnDevice("null values in device column")
     if pa.types.is_string(dtype) or pa.types.is_large_string(dtype):
         assert dictionary is not None
         return dictionary.encode(arr)
+    if arr.null_count:
+        raise UnsupportedOnDevice("null values in device column")
     if pa.types.is_floating(dtype):
         return arr.to_numpy(zero_copy_only=False).astype(np.float32)
     if pa.types.is_date(dtype):

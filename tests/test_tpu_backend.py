@@ -346,10 +346,16 @@ def test_global_count_over_empty_input(tpch_dir):
                 assert got == want, (name, sql, got)
 
 
-def test_is_null_on_string_column_device(tmp_path):
-    """Dictionary-encoded string columns carry nulls as -1 codes on device;
-    IS [NOT] NULL must test the code, not constant-fold."""
+def test_null_string_predicates_device(tmp_path):
+    """Dictionary-encoded string columns carry nulls as -1 codes on device.
+    IS [NOT] NULL tests the code; =, <>, LIKE, NOT LIKE, IN, NOT IN follow
+    three-valued logic (NULL rows excluded, even under negation — a -1
+    gather would otherwise wrap to the table's last entry). Asserts the
+    device stage actually ran."""
     import pyarrow.parquet as pq
+
+    from ballista_tpu.ops import kernels, runtime
+    from ballista_tpu.ops.stage import FusedAggregateStage
 
     t = pa.table({
         "k": pa.array(["a", None, "b", None, "a", "c"]),
@@ -357,12 +363,41 @@ def test_is_null_on_string_column_device(tmp_path):
     })
     (tmp_path / "t").mkdir()
     pq.write_table(t, str(tmp_path / "t" / "p0.parquet"))
+    cases = [
+        ("k is null", 2),
+        ("k is not null", 4),
+        ("k = 'a'", 2),
+        ("k <> 'a'", 2),       # NULL rows excluded
+        ("k like 'a%'", 2),
+        ("k not like 'a%'", 2),  # NULL rows excluded
+        ("k in ('a', 'c')", 3),
+        ("k not in ('a', 'c')", 1),  # only 'b'; NULL rows excluded
+        ("not (k = 'a')", 2),   # Kleene NOT: NULL stays NULL -> excluded
+        ("not (k <> 'a' or k = 'c')", 2),  # NOT over Kleene OR
+        ("coalesce(k, 'x') = 'x'", 2),  # NULL coalesces to 'x' -> matches
+        ("coalesce(k, 'a') <> 'a'", 2),  # b, c
+    ]
+    kernels._stage_cache.clear()
+    kernels._stage_cache_pins.clear()
+    kernels._stage_latest.clear()
+    runtime.reset_residency()
     for backend in ("cpu", "tpu"):
         ctx = make_ctx(backend)
         ctx.register_parquet("t", str(tmp_path / "t"))
-        n_null = ctx.sql("select count(*) as c from t where k is null").collect()
-        n_notnull = ctx.sql("select count(*) as c from t where k is not null").collect()
-        s = ctx.sql("select sum(v) as s from t where k is not null").collect()
-        assert n_null.column("c").to_pylist() == [2], backend
-        assert n_notnull.column("c").to_pylist() == [4], backend
-        assert s.column("s").to_pylist() == [15.0], backend
+        for pred, want in cases:
+            out = ctx.sql(f"select count(*) as c from t where {pred}").collect()
+            assert out.column("c").to_pylist() == [want], (backend, pred)
+        # COUNT(k) counts only non-null values; the device declines (host
+        # fallback) rather than counting -1 codes
+        out = ctx.sql("select count(k) as c from t").collect()
+        assert out.column("c").to_pylist() == [4], backend
+    # EVERY predicate query must have taken the device path — a silent
+    # host fallback (cache value False) would also produce correct counts
+    declined = [k for k, v in kernels._stage_cache.items()
+                if v is False and "COUNT(k@0)" not in k]
+    assert not declined, f"silent host fallback for: {declined[:2]}"
+    ran = [
+        s for s in kernels._stage_cache.values()
+        if isinstance(s, FusedAggregateStage) and s._device_cache
+    ]
+    assert len(ran) >= len(cases), (len(ran), len(cases))
