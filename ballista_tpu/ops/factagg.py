@@ -52,7 +52,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from ballista_tpu.ops.runtime import UnsupportedOnDevice
+from ballista_tpu.ops.runtime import UnsupportedOnDevice, widen_cols
 from ballista_tpu.ops.stage import (
     FusedAggregateStage,
     _SCAN_TYPES,
@@ -559,6 +559,7 @@ class FactAggregateStage:
 
         @jax.jit
         def step_sec(cols, aux, pad, m_tiles, p_rank, allowed):
+            cols = widen_cols(cols)  # narrow residency -> canonical dtypes
             mask0 = pad
             for fm in filter_masks:
                 mask0 = jnp.logical_and(mask0, fm(cols, aux))

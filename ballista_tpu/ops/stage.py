@@ -31,7 +31,9 @@ from ballista_tpu.ops.runtime import (
     UnsupportedOnDevice,
     bucket_rows,
     column_to_numpy,
+    narrow_to_device,
     pad_to,
+    widen_cols,
 )
 from ballista_tpu.physical import expr as px
 from ballista_tpu.physical.basic import (
@@ -279,6 +281,10 @@ class FusedAggregateStage:
         self._step = self._build_step()
         self._sorted_step = None  # built on first high-cardinality partition
         self._device_cache: Dict[int, dict] = {}
+        # col idx -> narrow-residency choice of the first batch; kept stable
+        # across batches/partitions so the jitted step compiles once
+        # (mutated only under _prepare_lock)
+        self._narrow_choice: Dict[int, str] = {}
         # executor task threads can run different partitions of one cached
         # stage concurrently; prepare mutates shared state (the growing
         # ColumnDictionary, compiled-step slots), so it is serialized
@@ -385,6 +391,8 @@ class FusedAggregateStage:
             )
 
         def step(num_segments, cols, aux, codes, row_valid):
+            cols = widen_cols(cols)  # narrow residency -> canonical dtypes
+            codes = codes.astype(jnp.int32)
             mask = row_valid
             for fm in filter_masks:
                 mask = jnp.logical_and(mask, fm(cols, aux))
@@ -420,6 +428,7 @@ class FusedAggregateStage:
         filter_masks = self.filter_masks
 
         def sstep(cols, aux, pad):
+            cols = widen_cols(cols)  # narrow residency -> canonical dtypes
             mask = pad
             for fm in filter_masks:
                 mask = jnp.logical_and(mask, fm(cols, aux))
@@ -615,9 +624,14 @@ class FusedAggregateStage:
             cols: Dict[int, object] = {}
             for idx, npcol in npcols.items():
                 fill = False if npcol.dtype == np.bool_ else 0
-                cols[idx] = jnp.asarray(pad_to(npcol, bucket, fill))
+                cols[idx], self._narrow_choice[idx] = narrow_to_device(
+                    npcol,
+                    lambda a: pad_to(a, bucket, fill),
+                    self._narrow_choice.get(idx),
+                )
             seg_bucket = bucket_rows(n_groups, 16) + 1  # +1 dump slot
-            codes_pad = pad_to(codes.astype(np.int32), bucket, 0)
+            # group codes fit int16 by construction (n_groups <= MAX_GROUPS)
+            codes_pad = pad_to(codes.astype(np.int16), bucket, 0)
             row_valid = np.zeros(bucket, dtype=np.bool_)
             row_valid[:n] = True
             entries.append(
@@ -668,7 +682,9 @@ class FusedAggregateStage:
         self._check_int_ranges(npcols, layout.L1)
         cols: Dict[int, object] = {}
         for idx, npcol in npcols.items():
-            cols[idx] = jnp.asarray(layout.materialize(npcol))
+            cols[idx], self._narrow_choice[idx] = narrow_to_device(
+                npcol, layout.materialize, self._narrow_choice.get(idx)
+            )
         derived = {
             name: jnp.asarray(layout.materialize(fn(npcols)))
             for name, fn in self.derive_columns.items()
@@ -730,6 +746,7 @@ class FusedAggregateStage:
 
         @jax.jit
         def masked_rows(cols, aux, row_valid):
+            cols = widen_cols(cols)
             mask = row_valid
             for fm in filter_masks:
                 mask = jnp.logical_and(mask, fm(cols, aux))

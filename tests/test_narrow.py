@@ -1,0 +1,174 @@
+"""Narrow device residency (runtime.narrow_column / widen_cols).
+
+HBM capacity and h2d bandwidth bound SF=100 (SURVEY §7 hard part 4): columns
+are stored narrow on device and widened in-program. These tests pin the
+contract: widening reproduces the canonical int32/f32 arrays bit-exactly,
+choices stay stable across batches (no per-batch retraces), and prepared
+stages actually hold narrow arrays (the residency win is real).
+"""
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.ops.runtime import (
+    _LUT_MAX_VALUES,
+    entry_device_bytes,
+    narrow_column,
+    widen_cols,
+)
+
+
+def _widen_np(cols):
+    """Host-side mirror of the in-program widen (avoids needing jax here)."""
+    out = widen_cols(cols)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def test_int32_narrows_by_range():
+    small = np.array([-5, 0, 100], dtype=np.int32)
+    mid = np.array([-30000, 0, 30000], dtype=np.int32)
+    big = np.array([0, 1 << 20], dtype=np.int32)
+    a, lut, ch = narrow_column(small)
+    assert a.dtype == np.int8 and lut is None and ch == "int8"
+    b, lut, ch = narrow_column(mid)
+    assert b.dtype == np.int16 and lut is None and ch == "int16"
+    c, lut, ch = narrow_column(big)
+    assert c.dtype == np.int32 and lut is None and ch == "int32"
+
+
+def test_int_choice_stable_across_batches():
+    # batch 2 would fit int8 on its own, but the prior (int16) wins: the
+    # jitted step must see ONE dtype per column across batches
+    wide_first = np.array([0, 3000], dtype=np.int32)
+    _, _, ch = narrow_column(wide_first)
+    assert ch == "int16"
+    small_second = np.array([1, 2], dtype=np.int32)
+    arr, _, ch2 = narrow_column(small_second, prior=ch)
+    assert ch2 == "int16" and arr.dtype == np.int16
+    # escalation the other way is allowed (one bounded retrace)
+    arr3, _, ch3 = narrow_column(wide_first, prior="int8")
+    assert ch3 == "int16" and arr3.dtype == np.int16
+
+
+def test_float32_lut_roundtrip_exact():
+    grid = np.round(np.arange(0, 0.11, 0.01), 2).astype(np.float32)  # 11 values
+    rng = np.random.default_rng(7)
+    col = grid[rng.integers(0, len(grid), 8192)]
+    codes, lut, ch = narrow_column(col)
+    assert ch == "lut" and codes.dtype == np.uint8
+    assert len(lut) == _LUT_MAX_VALUES  # fixed length: stable jit signature
+    import jax.numpy as jnp
+
+    wide = _widen_np({0: (jnp.asarray(codes), jnp.asarray(lut))})[0]
+    assert wide.dtype == np.float32
+    np.testing.assert_array_equal(wide, col)  # bit-exact, not approx
+
+
+def test_float32_high_cardinality_stays_wide():
+    col = np.random.default_rng(0).uniform(0, 1e6, 8192).astype(np.float32)
+    a, lut, ch = narrow_column(col)
+    assert a.dtype == np.float32 and lut is None and ch == "wide"
+    # and a "wide" prior skips the sample/encode probe entirely
+    a2, lut2, ch2 = narrow_column(col, prior="wide")
+    assert lut2 is None and ch2 == "wide"
+
+
+def test_float32_small_batches_skip_lut_unless_prior():
+    col = np.zeros(128, dtype=np.float32)  # under _LUT_MIN_ROWS
+    _, lut, ch = narrow_column(col)
+    assert lut is None
+    # a remainder batch of a column earlier batches LUT-encoded must keep
+    # the (codes, lut) structure — a structure flip would retrace the step
+    _, lut2, ch2 = narrow_column(col, prior="lut")
+    assert lut2 is not None and ch2 == "lut"
+
+
+def test_widen_cols_int_roundtrip():
+    import jax.numpy as jnp
+
+    src = np.array([-7, 0, 90], dtype=np.int32)
+    narrow, _, _ = narrow_column(src)
+    wide = _widen_np({3: jnp.asarray(narrow)})[3]
+    assert wide.dtype == np.int32
+    np.testing.assert_array_equal(wide, src)
+    # bools and wide arrays pass through untouched
+    b = jnp.asarray(np.array([True, False]))
+    assert _widen_np({0: b})[0].dtype == np.bool_
+
+
+def test_prepared_stage_holds_narrow_arrays(tmp_path):
+    """End-to-end: a fused aggregation over a q1-shaped table keeps narrow
+    residency on device and still matches the host backend exactly."""
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+
+    rng = np.random.default_rng(11)
+    n = 20_000
+    table = pa.table(
+        {
+            "flag": pa.array(rng.choice(["A", "N", "R"], n)),
+            "qty": pa.array(rng.integers(1, 51, n).astype(np.int64)),
+            "price": pa.array(
+                (rng.integers(900, 10_000, n) / 100.0).astype(np.float64)
+            ),
+            "disc": pa.array(
+                np.round(rng.integers(0, 11, n) / 100.0, 2).astype(np.float64)
+            ),
+        }
+    )
+    pq.write_table(table, tmp_path / "t.parquet")
+    sql = (
+        "select flag, sum(qty) as sq, sum(price * (1 - disc)) as rev, "
+        "count(*) as c from t group by flag order by flag"
+    )
+    results = {}
+    stages = {}
+    for backend in ("tpu", "cpu"):
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        ctx.register_parquet("t", str(tmp_path))
+        results[backend] = ctx.sql(sql).collect().to_pydict()
+        if backend == "tpu":
+            from ballista_tpu.ops import kernels
+
+            with kernels._stage_cache_lock:
+                stages = {
+                    k: v for k, v in kernels._stage_cache.items() if v
+                }
+    assert results["tpu"]["flag"] == results["cpu"]["flag"]
+    assert results["tpu"]["sq"] == results["cpu"]["sq"]
+    np.testing.assert_allclose(
+        results["tpu"]["rev"], results["cpu"]["rev"], rtol=1e-6
+    )
+    # at least one cached stage holds a narrowed device column: qty (int8)
+    # or disc (uint8 LUT codes)
+    def narrow_kinds(stage):
+        found = []
+        for ent in getattr(stage, "_device_cache", {}).values():
+            entries = ent["entries"] if ent.get("kind") == "batches" else [ent]
+            for e in entries:
+                for v in e.get("cols", {}).values():
+                    if isinstance(v, tuple):
+                        found.append("lut")
+                    elif v.dtype.itemsize < 4 and str(v.dtype) != "bool":
+                        found.append(str(v.dtype))
+        return found
+
+    narrowed = [f for s in stages.values() for f in narrow_kinds(s)]
+    assert narrowed, "expected at least one narrow device column"
+    assert "lut" in narrowed or "int8" in narrowed
+
+
+def test_entry_device_bytes_counts_lut_tuples():
+    import jax.numpy as jnp
+
+    entry = {
+        "cols": {
+            0: (jnp.zeros(1024, dtype=jnp.uint8), jnp.zeros(16, dtype=jnp.float32)),
+            1: jnp.zeros(1024, dtype=jnp.int16),
+        }
+    }
+    assert entry_device_bytes(entry) == 1024 + 64 + 2048
