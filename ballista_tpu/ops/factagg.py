@@ -52,7 +52,12 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.compute as pc
 
-from ballista_tpu.ops.runtime import UnsupportedOnDevice, widen_cols
+from ballista_tpu.ops.runtime import (
+    UnsupportedOnDevice,
+    bucket_rows,
+    pad_to,
+    widen_cols,
+)
 from ballista_tpu.ops.stage import (
     FusedAggregateStage,
     _SCAN_TYPES,
@@ -70,8 +75,18 @@ from ballista_tpu.physical.basic import (
 )
 
 # dim sides larger than this are not "dimension tables"; let the host join
-# handle them
-MAX_DIM_ROWS = 4_000_000
+# handle them. The ceiling is host-side cost only (one cached collect +
+# sort + unique check; the device never sees dim rows, just fact-rank
+# membership bits), so it is sized for SF=100 TPC-H dim shapes: q3's
+# filtered customer x orders side is ~15M rows, q10's window ~6M. A 4M
+# ceiling silently pushed exactly those queries back onto the host path at
+# the scale the ≥5x target is defined on.
+MAX_DIM_ROWS = 32_000_000
+
+# the non-topk member-select epilogue reads back one column per member and
+# re-groups on host; that path keeps the old tighter ceiling (the raised
+# MAX_DIM_ROWS is sized for the topk epilogue, whose readback is O(k))
+MAX_SELECT_MEMBERS = 4_000_000
 
 # group_layout marker for "this output column is the fact join key" — a
 # sentinel object so it can never collide with a real dim column name
@@ -529,11 +544,14 @@ class FactAggregateStage:
             raise UnsupportedOnDevice("secondary qualification not attr-pure")
         if len(allowed) > 256:
             raise UnsupportedOnDevice("too many secondary classes")
-        # group values: unique per class, gathered in `allowed` order
+        # group values: unique per class, gathered in `allowed` order.
+        # First-occurrence rows come from np.unique (a per-row Python loop
+        # here would take ~10s on an SF=100-sized secondary table).
         group_values = {}
-        first_row_for_attr = {}
-        for i, v in enumerate(attrs):
-            first_row_for_attr.setdefault(int(v), i)
+        uniq_attrs, first_idx = np.unique(attrs.astype(np.int64), return_index=True)
+        first_row_for_attr = dict(
+            zip(uniq_attrs.tolist(), first_idx.tolist())
+        )
         for name, _out in sec["group_cols"]:
             col = table.column(name)
             enc = pc.dictionary_encode(col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col)
@@ -781,6 +799,9 @@ class FactAggregateStage:
     def _prepare(self, partition: int, ctx) -> dict:
         ent = self._prepared.get(partition)
         if ent is not None:
+            from ballista_tpu.ops.runtime import touch_residency
+
+            touch_residency(self, partition)  # LRU recency for eviction
             return ent
         # concurrent executor task threads: serialize prepare (shared
         # growing dictionaries / compiled-step slots), same as the inner
@@ -833,6 +854,10 @@ class FactAggregateStage:
         if self.secondary is not None:
             return self._run_secondary(self._prepare(partition, ctx), ctx)
         dim = self._dim_side(ctx)
+        if self.topk is None and dim["table"].num_rows > MAX_SELECT_MEMBERS:
+            # members <= dim rows: decline BEFORE prepare pays the fact
+            # upload (the per-query check below would fire after it)
+            raise UnsupportedOnDevice("member-select dim side too large")
         ent = self._prepare(partition, ctx)
         if ent["kind"] == "empty" or dim["table"].num_rows == 0:
             return self.partial_schema.empty_table()
@@ -895,11 +920,20 @@ class FactAggregateStage:
         positions = member_ranks.astype(np.int64)
         if len(positions) == 0:
             return self.partial_schema.empty_table()
-        sel = np.asarray(
-            self._fact_step(
-                ent["cols"], aux, ent["pad"], jnp.asarray(positions.astype(np.int32))
-            )
+        if len(positions) > MAX_SELECT_MEMBERS:
+            # the non-topk epilogue reads back [state_rows, members] — at
+            # dim cardinalities past this the transfer (and per-query host
+            # re-group) costs more than the host path; decline
+            raise UnsupportedOnDevice("member-select readback too large")
+        # bucket the gather width: an exact-length positions array would
+        # recompile step_select for every distinct member count
+        n_pos = len(positions)
+        pos_pad = pad_to(
+            positions.astype(np.int32), bucket_rows(n_pos, 16), 0
         )
+        sel = np.asarray(
+            self._fact_step(ent["cols"], aux, ent["pad"], jnp.asarray(pos_pad))
+        )[:, :n_pos]
         rows = self._decode(sel)
         keep = rows[0] > 0
         return self._assemble_decoded(

@@ -121,15 +121,19 @@ class ScanDictionaries:
 
 
 # -- device residency accounting -------------------------------------------
-# One chip's HBM is shared by every cached stage; partitions that would push
-# the total past the configured budget stream per query instead of pinning.
-# First-come residency (hot partitions prepared first stay resident); a
+# One chip's HBM is shared by every cached stage; when a new partition would
+# push the total past the configured budget, other stages' least-recently
+# used pins are evicted to make room (re-prepared on their next touch), and
+# only an entry that cannot fit even after eviction streams per query. A
 # stage invalidated by the kernel dispatcher releases its reservations.
 import threading
+import time
 
 _res_lock = threading.Lock()
 _resident_bytes = 0
 _reservations: dict = {}  # token -> bytes
+_pinned: dict = {}  # token -> (stage, partition), for LRU eviction
+_last_used: dict = {}  # token -> monotonic time of last cached run
 
 
 def entry_device_bytes(obj) -> int:
@@ -154,6 +158,16 @@ def reserve_and_pin(stage, partition: int, entry, cache: dict, nbytes: int, budg
     """Atomically reserve HBM budget AND insert the prepared entry into the
     stage's cache dict, refusing retired stages.
 
+    When the budget is full, OTHER stages' pinned partitions are evicted
+    least-recently-used-first until the new entry fits (touch_residency
+    maintains recency). First-come residency would make every query after
+    the budget fills stream per-iteration forever — fatal at SF=100, where
+    q1's lineitem residency alone is most of a 16 GB chip and the suite
+    visits many stages. With LRU, the working set follows the query mix and
+    an evicted stage simply re-prepares on its next touch. Eviction is safe
+    mid-run: a task thread inside the victim's step holds Python references
+    to its device arrays, so compute completes; only the cache entry goes.
+
     A task thread may still be inside stage.run() when another thread
     evicts that stage (superseded mtimes) and releases its reservations.
     The retired check, the reservation, and the dict insert all happen
@@ -167,12 +181,86 @@ def reserve_and_pin(stage, partition: int, entry, cache: dict, nbytes: int, budg
         if getattr(stage, "_retired", False):
             return False
         if token not in _reservations:
+            if nbytes > budget:
+                return False  # can never fit; do NOT disturb other pins
+            if _resident_bytes + nbytes > budget:
+                _evict_lru_locked(stage, nbytes, budget)
             if _resident_bytes + nbytes > budget:
                 return False
             _reservations[token] = nbytes
             _resident_bytes += nbytes
+            _pinned[token] = (stage, partition)
+        _last_used[token] = time.monotonic()
         cache[partition] = entry
         return True
+
+
+# refuse an eviction plan that frees more than this multiple of the bytes
+# requested: re-uploading a 15 GB pin to admit a 2 GB one costs more relay
+# time than the newcomer streaming ever would, and two such stages
+# alternating would thrash the whole budget every query
+_EVICT_COST_RATIO = 4
+# a stage evicted within this window is immune from re-eviction: in an
+# A,B,A,B access pattern where A and B fit alone but not together, plain
+# LRU would make EVERY query a full re-prepare (both stages thrash); after
+# one thrash cycle the cooldown pins the survivor and the other streams —
+# the same steady state first-come residency gave that pattern, while
+# sequential workloads (the bench / the 22-query suite) still evict freely
+_EVICT_COOLDOWN_S = 60.0
+_evicted_at: dict = {}  # id(stage) -> monotonic time of last eviction
+
+
+def _evict_lru_locked(requesting_stage, nbytes: int, budget: int) -> None:
+    """Evict other stages' pinned partitions, oldest touch first, until
+    `nbytes` fits. Caller holds _res_lock. The requesting stage's own
+    entries are never victims (evicting them to fit a sibling partition of
+    the same stage would thrash a multi-partition prepare loop), recently
+    evicted stages are immune (thrash cooldown), and the whole plan is
+    abandoned — nothing evicted — when it cannot fit the request or would
+    free more than _EVICT_COST_RATIO times the request."""
+    global _resident_bytes
+    now = time.monotonic()
+    for sid in [s for s, ts in _evicted_at.items() if now - ts > _EVICT_COOLDOWN_S]:
+        del _evicted_at[sid]
+    candidates = sorted(
+        (
+            t
+            for t, (s, _p) in _pinned.items()
+            if s is not requesting_stage and id(s) not in _evicted_at
+        ),
+        key=lambda t: _last_used.get(t, 0.0),
+    )
+    need = _resident_bytes + nbytes - budget
+    chosen, freed = [], 0
+    for t in candidates:
+        if freed >= need:
+            break
+        size = _reservations.get(t, 0)
+        if size > _EVICT_COST_RATIO * nbytes:
+            continue  # huge victim for a small need: leave it resident
+        chosen.append(t)
+        freed += size
+    if freed < need or freed > _EVICT_COST_RATIO * nbytes:
+        return  # plan doesn't fit or costs more than it buys — evict nothing
+    for t in chosen:
+        victim_stage, p = _pinned.pop(t)
+        _evicted_at[id(victim_stage)] = now
+        _last_used.pop(t, None)
+        _resident_bytes -= _reservations.pop(t, 0)
+        for attr in ("_device_cache", "_prepared"):
+            c = getattr(victim_stage, attr, None)
+            if c is not None:
+                c.pop(p, None)
+
+
+def touch_residency(stage, partition: int) -> None:
+    """Record a cache hit for LRU ordering. Only refreshes live pins: a
+    racing eviction may have dropped the token already, and re-inserting
+    _last_used for it would leak bookkeeping no release path sweeps."""
+    token = (id(stage), partition)
+    with _res_lock:
+        if token in _pinned:
+            _last_used[token] = time.monotonic()
 
 
 _stack_jit = None
@@ -222,6 +310,8 @@ def release_residency(token) -> None:
     global _resident_bytes
     with _res_lock:
         _resident_bytes -= _reservations.pop(token, 0)
+        _pinned.pop(token, None)
+        _last_used.pop(token, None)
 
 
 def release_stage_residency(stage) -> None:
@@ -236,7 +326,10 @@ def release_stage_residency(stage) -> None:
             cache = getattr(stage, attr, None)
             if cache:
                 for p in list(cache):
-                    _resident_bytes -= _reservations.pop((id(stage), p), 0)
+                    token = (id(stage), p)
+                    _resident_bytes -= _reservations.pop(token, 0)
+                    _pinned.pop(token, None)
+                    _last_used.pop(token, None)
                 cache.clear()
 
 
@@ -249,6 +342,9 @@ def reset_residency() -> None:
     with _res_lock:
         _resident_bytes = 0
         _reservations.clear()
+        _pinned.clear()
+        _last_used.clear()
+        _evicted_at.clear()
 
 
 def bucket_rows(n: int, minimum: int = 1024) -> int:
@@ -339,11 +435,12 @@ def narrow_column(
         if choice == "int32":
             return npcol, None, choice
         return npcol.astype(choice), None, choice
-    if (
-        npcol.dtype == np.float32
-        and (len(npcol) >= _LUT_MIN_ROWS or prior == "lut")
-        and prior in (None, "lut")
-    ):
+    if npcol.dtype == np.float32 and prior in (None, "lut"):
+        if len(npcol) < _LUT_MIN_ROWS and prior != "lut":
+            # too small to judge; stay UNDECIDED — a "wide" verdict here
+            # would be sticky and lock a large later batch (prepare order
+            # across partitions is arbitrary) out of LUT narrowing
+            return npcol, None, prior
         # cheap sample gate first: a high-cardinality column (extendedprice
         # at SF=100 is ~1M distinct floats) must not pay a full
         # dictionary_encode just to discover it cannot LUT-encode

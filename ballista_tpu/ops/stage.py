@@ -794,6 +794,10 @@ class FusedAggregateStage:
             # wash-to-loss on relay-attached chips, so it is opt-in
             raise UnsupportedOnDevice("volatile row source (enable ballista.tpu.fuse_volatile_sources)")
         prepared = self._device_cache.get(partition) if use_cache else None
+        if prepared is not None:
+            from ballista_tpu.ops.runtime import touch_residency
+
+            touch_residency(self, partition)  # LRU recency for eviction
         if prepared is None:
             with self._prepare_lock:
                 prepared = self._device_cache.get(partition) if use_cache else None

@@ -1,0 +1,106 @@
+"""HBM residency accounting: LRU eviction replaces first-come streaming.
+
+When the budget fills, the least-recently-touched pins of OTHER stages are
+evicted (their stages re-prepare on next touch); an entry that cannot fit
+even after eviction streams. First-come residency would have made every
+query after the budget filled stream per iteration — fatal for the SF=100
+suite where one stage's lineitem residency is most of the chip.
+"""
+
+import numpy as np
+import pytest
+
+from ballista_tpu.ops import runtime
+
+
+class _FakeStage:
+    def __init__(self):
+        self._device_cache = {}
+
+
+@pytest.fixture(autouse=True)
+def _clean_residency():
+    runtime.reset_residency()
+    yield
+    runtime.reset_residency()
+
+
+def test_lru_evicts_oldest_other_stage():
+    a, b, c = _FakeStage(), _FakeStage(), _FakeStage()
+    budget = 100
+    assert runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 40, budget)
+    assert runtime.reserve_and_pin(b, 0, {"x": 2}, b._device_cache, 40, budget)
+    runtime.touch_residency(a, 0)  # a is now more recent than b
+    # c needs 40: evicting b (oldest) suffices; a must survive
+    assert runtime.reserve_and_pin(c, 0, {"x": 3}, c._device_cache, 40, budget)
+    assert 0 in a._device_cache
+    assert 0 not in b._device_cache, "LRU victim must be dropped"
+    assert 0 in c._device_cache
+    assert runtime.resident_bytes() == 80
+
+
+def test_own_partitions_never_victims():
+    a = _FakeStage()
+    budget = 100
+    assert runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 60, budget)
+    # a second partition of the SAME stage must not evict the first; it
+    # simply fails to pin (streams per query)
+    assert not runtime.reserve_and_pin(a, 1, {"x": 2}, a._device_cache, 60, budget)
+    assert 0 in a._device_cache and 1 not in a._device_cache
+    assert runtime.resident_bytes() == 60
+
+
+def test_oversized_entry_streams_without_evicting():
+    a, b = _FakeStage(), _FakeStage()
+    budget = 100
+    assert runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 50, budget)
+    # b can NEVER fit: it must stream without disturbing a's pin (an
+    # eviction sweep here would repeat on every one of b's queries)
+    assert not runtime.reserve_and_pin(b, 0, {"x": 2}, b._device_cache, 150, budget)
+    assert runtime.resident_bytes() == 50
+    assert 0 in a._device_cache
+
+
+def test_huge_victim_not_evicted_for_small_need():
+    """Evicting a pin much larger than the request costs more re-upload
+    than the newcomer streaming ever would (A/B alternation thrash)."""
+    a, b = _FakeStage(), _FakeStage()
+    budget = 100
+    assert runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 95, budget)
+    # b needs 10; the only victim holds 95 > 4x10 — b streams, a survives
+    assert not runtime.reserve_and_pin(b, 0, {"x": 2}, b._device_cache, 10, budget)
+    assert 0 in a._device_cache
+    assert runtime.resident_bytes() == 95
+
+
+def test_multi_victim_eviction_plan():
+    a, b, c = _FakeStage(), _FakeStage(), _FakeStage()
+    budget = 100
+    assert runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 30, budget)
+    assert runtime.reserve_and_pin(b, 0, {"x": 2}, b._device_cache, 30, budget)
+    # c needs 80: both victims (60 total <= 4x80) go, oldest first
+    assert runtime.reserve_and_pin(c, 0, {"x": 3}, c._device_cache, 80, budget)
+    assert 0 not in a._device_cache and 0 not in b._device_cache
+    assert runtime.resident_bytes() == 80
+
+
+def test_release_stage_clears_lru_bookkeeping():
+    a = _FakeStage()
+    assert runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 10, 100)
+    runtime.release_stage_residency(a)
+    assert runtime.resident_bytes() == 0
+    assert not runtime._pinned and not runtime._last_used
+    # retired stages refuse new pins
+    assert not runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 10, 100)
+
+
+def test_eviction_preserves_running_consumers():
+    """An evicted entry's arrays stay alive for a thread already holding
+    them (Python references) — eviction only drops the cache slot."""
+    a, b = _FakeStage(), _FakeStage()
+    arr = np.arange(8)
+    assert runtime.reserve_and_pin(a, 0, {"arr": arr}, a._device_cache, 60, 100)
+    held = a._device_cache[0]["arr"]  # a task thread's reference
+    assert runtime.reserve_and_pin(b, 0, {"x": 1}, b._device_cache, 60, 100)
+    assert 0 not in a._device_cache
+    np.testing.assert_array_equal(held, np.arange(8))
