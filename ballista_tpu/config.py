@@ -79,7 +79,12 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_FUSE_VOLATILE: "false",
     BALLISTA_TPU_SPMD: "false",
     BALLISTA_TPU_COALESCE_AGG: "auto",
-    BALLISTA_TPU_COALESCE_MAX: str(6 << 30),
+    # sized for TPC-H SF=100 (leaf parquet ~18 GB): narrow residency keeps
+    # the DEVICE footprint at roughly on-disk scale (~2.2x below decoded
+    # int32/f32), and the fact-agg top-k epilogue only exists on the
+    # SINGLE-mode plan — a smaller cap silently pushed q3/q5 onto the
+    # partial/final host path at exactly the scale the ≥5x target names
+    BALLISTA_TPU_COALESCE_MAX: str(24 << 30),
     BALLISTA_TPU_SORTED_KERNEL: "layout",
     BALLISTA_DATA_ROOTS: "",
 }

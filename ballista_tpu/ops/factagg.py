@@ -578,6 +578,7 @@ class FactAggregateStage:
         @jax.jit
         def step_sec(cols, aux, pad, m_tiles, p_rank, allowed):
             cols = widen_cols(cols)  # narrow residency -> canonical dtypes
+            m_tiles = m_tiles.astype(jnp.int32)  # derived tiles ride narrow
             mask0 = pad
             for fm in filter_masks:
                 mask0 = jnp.logical_and(mask0, fm(cols, aux))

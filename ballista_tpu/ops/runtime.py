@@ -253,6 +253,15 @@ def _evict_lru_locked(requesting_stage, nbytes: int, budget: int) -> None:
                 c.pop(p, None)
 
 
+def make_headroom(stage, nbytes: int, budget: int) -> None:
+    """Best-effort LRU eviction BEFORE a large upload. reserve_and_pin only
+    evicts at pin time — after the transfer — which is too late to save the
+    chip when other stages' pins plus the incoming tiles would exceed HBM."""
+    with _res_lock:
+        if _resident_bytes + nbytes > budget:
+            _evict_lru_locked(stage, nbytes, budget)
+
+
 def touch_residency(stage, partition: int) -> None:
     """Record a cache hit for LRU ordering. Only refreshes live pins: a
     racing eviction may have dropped the token already, and re-inserting
@@ -458,19 +467,6 @@ def narrow_column(
     return npcol, None, "wide"
 
 
-def narrow_to_device(
-    npcol: np.ndarray, transform, prior: Optional[str] = None
-) -> Tuple[object, str]:
-    """Shared upload helper: narrow, lay out (pad/materialize via
-    `transform`), transfer; LUT columns travel as a (codes, lut) device
-    tuple — the single encoding widen_cols understands."""
-    import jax.numpy as jnp
-
-    narrow, lut, choice = narrow_column(npcol, prior)
-    dev = jnp.asarray(transform(narrow))
-    if lut is None:
-        return dev, choice
-    return (dev, jnp.asarray(lut)), choice
 
 
 def widen_cols(cols: dict) -> dict:

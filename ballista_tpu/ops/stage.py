@@ -31,7 +31,8 @@ from ballista_tpu.ops.runtime import (
     UnsupportedOnDevice,
     bucket_rows,
     column_to_numpy,
-    narrow_to_device,
+    make_headroom,
+    narrow_column,
     pad_to,
     widen_cols,
 )
@@ -176,6 +177,23 @@ def substitute_columns(e: px.PhysicalExpr, mapping: List[px.PhysicalExpr]) -> px
     raise UnsupportedOnDevice(f"cannot inline {type(e).__name__}")
 
 
+def _upload_staged(staged: Dict, choices: Dict) -> Dict:
+    """Transfer staged (array, lut, choice) columns, recording the narrow
+    choice per key and freeing each host tile right after its device copy
+    exists — peak host memory holds one column in flight, not the whole
+    stage. The (dev, lut) tuple is the single LUT encoding widen_cols
+    understands; both device paths must build it here."""
+    import jax.numpy as jnp
+
+    cols: Dict = {}
+    for idx in list(staged):
+        arr, lut, choice = staged.pop(idx)
+        choices[idx] = choice
+        dev = jnp.asarray(arr)
+        cols[idx] = dev if lut is None else (dev, jnp.asarray(lut))
+    return cols
+
+
 class FusedAggregateStage:
     """Compiled device pipeline for one HashAggregateExec (partial phase)."""
 
@@ -281,10 +299,11 @@ class FusedAggregateStage:
         self._step = self._build_step()
         self._sorted_step = None  # built on first high-cardinality partition
         self._device_cache: Dict[int, dict] = {}
-        # col idx -> narrow-residency choice of the first batch; kept stable
-        # across batches/partitions so the jitted step compiles once
+        # narrow-residency choice of the first batch, keyed by col index
+        # (or "derived:<name>" for derived tiles); kept stable across
+        # batches/partitions so the jitted step compiles once
         # (mutated only under _prepare_lock)
-        self._narrow_choice: Dict[int, str] = {}
+        self._narrow_choice: Dict[object, str] = {}
         # executor task threads can run different partitions of one cached
         # stage concurrently; prepare mutates shared state (the growing
         # ColumnDictionary, compiled-step slots), so it is serialized
@@ -605,6 +624,11 @@ class FusedAggregateStage:
         import jax.numpy as jnp
 
         entries: List[dict] = []
+        # all of a partition's batch entries are live on device at once
+        # during run(); past the budget, decline to the host path rather
+        # than OOM the chip (mirrors the sorted path's staged check)
+        budget = ctx.config.tpu_hbm_budget()
+        total_bytes = 0
         for batch in self._scan_batches(partition, ctx):
             if batch.num_rows == 0:
                 continue
@@ -621,14 +645,22 @@ class FusedAggregateStage:
                 raise TooManyGroups(f"{n_groups} groups exceeds unrolled path")
             npcols = self._lower_columns(batch)
             self._check_int_ranges(npcols, n)
-            cols: Dict[int, object] = {}
+            staged: Dict[int, tuple] = {}
             for idx, npcol in npcols.items():
                 fill = False if npcol.dtype == np.bool_ else 0
-                cols[idx], self._narrow_choice[idx] = narrow_to_device(
-                    npcol,
-                    lambda a: pad_to(a, bucket, fill),
-                    self._narrow_choice.get(idx),
+                narrow, lut, choice = narrow_column(
+                    npcol, self._narrow_choice.get(idx)
                 )
+                padded = pad_to(narrow, bucket, fill)
+                staged[idx] = (padded, lut, choice)
+                total_bytes += padded.nbytes + (0 if lut is None else lut.nbytes)
+            total_bytes += 3 * bucket  # int16 codes + bool row_valid
+            if total_bytes > budget:
+                raise UnsupportedOnDevice(
+                    f"stage batches ({total_bytes >> 20} MiB) exceed the HBM budget"
+                )
+            make_headroom(self, total_bytes, budget)
+            cols = _upload_staged(staged, self._narrow_choice)
             seg_bucket = bucket_rows(n_groups, 16) + 1  # +1 dump slot
             # group codes fit int16 by construction (n_groups <= MAX_GROUPS)
             codes_pad = pad_to(codes.astype(np.int16), bucket, 0)
@@ -674,21 +706,50 @@ class FusedAggregateStage:
             # counts accumulate in f32 inside the kernel: exact only below 2^24
             and batch.num_rows <= (1 << 24)
         ):
-            return self._prepare_pallas_sorted(batch, codes, key_values, n_groups)
+            return self._prepare_pallas_sorted(batch, codes, key_values, n_groups, ctx)
         layout = SortedSegmentLayout(
             codes, n_groups, cover_max=getattr(self, "sorted_cover_max", False)
         )
         npcols = self._lower_columns(batch)
         self._check_int_ranges(npcols, layout.L1)
-        cols: Dict[int, object] = {}
+        # stage narrow tiles HOST-side and check the HBM budget BEFORE any
+        # device allocation: the planner's coalesce cap compares compressed
+        # leaf bytes, which under-counts columns that fail to narrow — a
+        # too-big stage must fall to the host path, not OOM the chip
+        staged: Dict[int, tuple] = {}
+        total = layout.pad.nbytes
         for idx, npcol in npcols.items():
-            cols[idx], self._narrow_choice[idx] = narrow_to_device(
-                npcol, layout.materialize, self._narrow_choice.get(idx)
+            narrow, lut, choice = narrow_column(npcol, self._narrow_choice.get(idx))
+            tiles = layout.materialize(narrow)
+            staged[idx] = (tiles, lut, choice)
+            total += tiles.nbytes + (lut.nbytes if lut is not None else 0)
+        staged_derived: Dict[str, tuple] = {}
+        for name, fn in self.derive_columns.items():
+            raw = fn(npcols)
+            if raw.dtype == np.int32:
+                # int-only narrowing: derived tiles travel as standalone
+                # step arguments (not through widen_cols), so the consumer
+                # widens with a plain astype — no LUT tuples here
+                key = f"derived:{name}"
+                narrow, _lut, choice = narrow_column(raw, self._narrow_choice.get(key))
+                tiles = layout.materialize(narrow)
+                staged_derived[name] = (tiles, key, choice)
+            else:
+                staged_derived[name] = (layout.materialize(raw), None, None)
+            total += staged_derived[name][0].nbytes
+        budget = ctx.config.tpu_hbm_budget()
+        if total > budget:
+            raise UnsupportedOnDevice(
+                f"stage tiles ({total >> 20} MiB) exceed the HBM budget"
             )
-        derived = {
-            name: jnp.asarray(layout.materialize(fn(npcols)))
-            for name, fn in self.derive_columns.items()
-        }
+        make_headroom(self, total, budget)
+        cols = _upload_staged(staged, self._narrow_choice)
+        derived = {}
+        for name in list(staged_derived):
+            tiles, key, choice = staged_derived.pop(name)
+            if key is not None:
+                self._narrow_choice[key] = choice
+            derived[name] = jnp.asarray(tiles)
         if self._sorted_step is None:
             self._sorted_step = self._build_sorted_step()
         return {
@@ -701,7 +762,7 @@ class FusedAggregateStage:
             "derived": derived,
         }
 
-    def _prepare_pallas_sorted(self, batch, codes, key_values, n_groups) -> dict:
+    def _prepare_pallas_sorted(self, batch, codes, key_values, n_groups, ctx) -> dict:
         """Flat sorted residency for the pallas MXU kernel
         (ops/pallas_kernels.py::sorted_grouped_sum)."""
         import jax.numpy as jnp
@@ -717,6 +778,18 @@ class FusedAggregateStage:
                 [codes_sorted, np.full(pad, codes_sorted[-1], np.int32)]
             )
         npcols = self._lower_columns(batch)
+        # same pre-allocation budget discipline as the layout path: this
+        # path uploads full-width columns, so a too-big partition must
+        # decline to the host, not OOM the chip
+        budget = ctx.config.tpu_hbm_budget()
+        total = (n + pad) * (4 + 1)  # codes int32 + row_valid bool
+        for npcol in npcols.values():
+            total += (n + pad) * npcol.dtype.itemsize
+        if total > budget:
+            raise UnsupportedOnDevice(
+                f"pallas stage columns ({total >> 20} MiB) exceed the HBM budget"
+            )
+        make_headroom(self, total, budget)
         cols: Dict[int, object] = {}
         for idx, npcol in npcols.items():
             flat = npcol[order]

@@ -48,7 +48,7 @@ class PhysicalPlanner:
         self,
         batch_size: int = 32768,
         coalesce_aggregates: bool = False,
-        coalesce_max_bytes: int = 6 << 30,
+        coalesce_max_bytes: int = 24 << 30,
     ) -> None:
         self.batch_size = batch_size
         # single-chip device execution: plan aggregations SINGLE over merged

@@ -94,6 +94,47 @@ def test_release_stage_clears_lru_bookkeeping():
     assert not runtime.reserve_and_pin(a, 0, {"x": 1}, a._device_cache, 10, 100)
 
 
+def test_stage_past_budget_declines_to_host(tmp_path):
+    """A stage whose tiles cannot fit the HBM budget must decline BEFORE
+    device allocation (host fallback), not OOM the chip — and results stay
+    correct via the host path."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+
+    rng = np.random.default_rng(2)
+    n = 60_000
+    t = pa.table(
+        {
+            "k": pa.array(rng.choice(["x", "y", "z"], n)),
+            "v": pa.array(rng.uniform(0, 1e6, n)),  # high-card: stays f32
+        }
+    )
+    pq.write_table(t, tmp_path / "t.parquet")
+    results = {}
+    for budget in ("32", str(1 << 30)):  # 32 bytes: nothing fits
+        ctx = ExecutionContext(
+            BallistaConfig(
+                {
+                    "ballista.executor.backend": "tpu",
+                    "ballista.tpu.hbm_budget_bytes": budget,
+                }
+            )
+        )
+        ctx.register_parquet("t", str(tmp_path))
+        out = ctx.sql(
+            "select k, sum(v) as s, count(*) as c from t group by k order by k"
+        ).collect()
+        results[budget] = out.to_pydict()
+    assert results["32"]["k"] == results[str(1 << 30)]["k"]
+    assert results["32"]["c"] == results[str(1 << 30)]["c"]
+    np.testing.assert_allclose(
+        results["32"]["s"], results[str(1 << 30)]["s"], rtol=1e-5
+    )
+
+
 def test_eviction_preserves_running_consumers():
     """An evicted entry's arrays stay alive for a thread already holding
     them (Python references) — eviction only drops the cache slot."""
