@@ -55,6 +55,11 @@ BALLISTA_TPU_COALESCE_MAX = "ballista.tpu.coalesce_max_bytes"
 # tiles, default) | "pallas" (MXU one-hot matmul with RMW DMA windows,
 # sum/count/avg only — measured slower on v5e, kept selectable)
 BALLISTA_TPU_SORTED_KERNEL = "ballista.tpu.sorted_kernel"
+# persisted device-layout cache (ops/layout_cache.py): warm starts skip the
+# O(N log N) host prepare (decode/encode/rank/sort/materialize) for
+# file-backed stages. "" disables; entries keyed by plan + file mtimes
+BALLISTA_TPU_LAYOUT_CACHE_DIR = "ballista.tpu.layout_cache_dir"
+BALLISTA_TPU_LAYOUT_CACHE_CAP = "ballista.tpu.layout_cache_cap_bytes"
 # comma-separated directory allowlist for scan paths in plans arriving over
 # the wire ("" = unrestricted, the standalone/local default). The reference
 # executes any deserialized plan (rust/executor/src/flight_service.rs:90-192);
@@ -86,6 +91,10 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     # partial/final host path at exactly the scale the ≥5x target names
     BALLISTA_TPU_COALESCE_MAX: str(24 << 30),
     BALLISTA_TPU_SORTED_KERNEL: "layout",
+    # default under the user cache dir so warm starts survive process AND
+    # session restarts; "" disables persistence entirely
+    BALLISTA_TPU_LAYOUT_CACHE_DIR: "~/.cache/ballista_tpu/layouts",
+    BALLISTA_TPU_LAYOUT_CACHE_CAP: str(64 << 30),
     BALLISTA_DATA_ROOTS: "",
 }
 
@@ -159,6 +168,16 @@ class BallistaConfig(Mapping[str, str]):
 
     def tpu_coalesce_max_bytes(self) -> int:
         return int(self._settings[BALLISTA_TPU_COALESCE_MAX])
+
+    def tpu_layout_cache_dir(self) -> str:
+        """Expanded layout-cache directory; "" = persistence disabled."""
+        import os
+
+        d = self._settings[BALLISTA_TPU_LAYOUT_CACHE_DIR].strip()
+        return os.path.expanduser(d) if d else ""
+
+    def tpu_layout_cache_cap(self) -> int:
+        return int(self._settings[BALLISTA_TPU_LAYOUT_CACHE_CAP])
 
     def tpu_sorted_kernel(self) -> str:
         k = self._settings[BALLISTA_TPU_SORTED_KERNEL].strip().lower()

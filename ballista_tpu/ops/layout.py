@@ -107,6 +107,38 @@ class SortedSegmentLayout:
             self._fold_starts = np.searchsorted(owner, grid)
 
     # ------------------------------------------------------------------
+    def state(self) -> dict:
+        """Persistable post-materialize scalars (ops/layout_cache.py).
+        row_take is intentionally absent: it is only needed to materialize,
+        and persisted entries carry already-materialized tiles."""
+        return {
+            "n_groups": int(self.n_groups),
+            "L1": int(self.L1),
+            "V": int(self.V),
+            "host_folds": bool(self._host_folds),
+            "one_chunk_per_group": bool(self.one_chunk_per_group),
+        }
+
+    @classmethod
+    def from_state(cls, meta: dict, owner: np.ndarray, pad: np.ndarray):
+        """Rehydrate a layout from persisted state; supports every
+        post-materialize consumer (fold_*, one_chunk_per_group checks) but
+        not materialize()."""
+        self = cls.__new__(cls)
+        self.n_groups = int(meta["n_groups"])
+        self.L1 = int(meta["L1"])
+        self.V = int(meta["V"])
+        self.owner = owner
+        self.pad = pad
+        self.row_take = None  # materialize() unsupported after rehydration
+        self._host_folds = bool(meta["host_folds"])
+        self.one_chunk_per_group = bool(meta["one_chunk_per_group"])
+        if self._host_folds and not self.one_chunk_per_group:
+            self._fold_starts = np.searchsorted(
+                owner, np.arange(self.n_groups, dtype=np.int64)
+            )
+        return self
+
     def materialize(self, col: np.ndarray) -> np.ndarray:
         """Lay a row-space column out as [V, L1] tiles (pad slots carry row
         0's value; every consumer masks with .pad)."""
