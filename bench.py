@@ -125,11 +125,15 @@ def _probe_device() -> None:
     """Wait for the TPU relay within a bounded budget before giving up.
 
     A transient relay outage at capture time must not void a round's
-    evidence: retry the probe for BENCH_PROBE_BUDGET seconds (default 600)
-    before exiting 3.  jax.devices() otherwise blocks forever and the whole
-    bench run hangs silently.
+    evidence: retry the probe for BENCH_PROBE_BUDGET seconds (default 1200)
+    before falling back.  jax.devices() otherwise blocks forever and the
+    whole bench run hangs silently.  On exhaustion, if any persisted session
+    capture exists under benchmarks/results/, emit it as the JSON line with
+    ``"stale": true`` plus the capture timestamp and the probe-failure tail
+    (exit 0) — the driver record must never be null while a capture exists.
+    Only when there is no capture at all does the run exit 3.
     """
-    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "600"))
+    budget = float(os.environ.get("BENCH_PROBE_BUDGET", "1200"))
     deadline = time.monotonic() + budget
     attempt = 0
     while True:
@@ -144,13 +148,82 @@ def _probe_device() -> None:
         if time.monotonic() >= deadline:
             print(
                 f"device backend unreachable after {attempt} probes over "
-                f"{budget:.0f}s ({err}); no benchmark possible",
+                f"{budget:.0f}s ({err}); falling back to persisted capture",
                 file=sys.stderr,
             )
-            raise SystemExit(3)
+            _emit_stale_capture(probe_error=str(err).splitlines()[0])
+            raise SystemExit(3)  # only reached when no capture exists
         print(f"device probe {attempt} failed; retrying "
               f"({remaining:.0f}s left in budget)", file=sys.stderr)
         time.sleep(min(30, max(5, remaining / 10)))
+
+
+RESULTS_DIR = REPO / "benchmarks" / "results"
+
+
+def _latest_session_capture() -> tuple[pathlib.Path, dict] | None:
+    """Most recent parseable session_*.json under benchmarks/results/."""
+    best = None
+    for p in sorted(RESULTS_DIR.glob("session_*.json"),
+                    key=lambda p: p.stat().st_mtime, reverse=True):
+        try:
+            d = json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not (isinstance(d, dict) and "metric" in d and "value" in d):
+            continue
+        # a CPU-jax capture (dev runs with JAX_PLATFORMS=cpu) must never
+        # stand in for device evidence; legacy captures carry no platform
+        # key and are device runs
+        if d.get("platform") == "cpu":
+            continue
+        best = (p, d)
+        break
+    return best
+
+
+def _emit_stale_capture(probe_error: str) -> None:
+    """Degrade to the last persisted capture instead of a null record.
+
+    Matches the reference harness's contract that a bench invocation always
+    yields a record (`rust/benchmarks/tpch/src/main.rs:117-183`); the
+    ``stale`` marker keeps provenance honest.
+    """
+    found = _latest_session_capture()
+    if found is None:
+        return
+    path, d = found
+    out = {
+        "metric": d["metric"],
+        "value": d["value"],
+        "unit": d.get("unit", "rows/s/chip"),
+        "vs_baseline": d.get("vs_baseline"),
+        "configs": d.get("configs", []),
+        "stale": True,
+        "captured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(path.stat().st_mtime)),
+        "capture_file": str(path.relative_to(REPO)) if path.is_relative_to(REPO)
+        else str(path),
+        "probe_error": probe_error,
+    }
+    print(json.dumps(out))
+    raise SystemExit(0)
+
+
+def _persist_capture(result: dict) -> None:
+    """Auto-persist every successful run so a later relay outage can fall
+    back to it; failure to persist must never fail the run."""
+    try:
+        RESULTS_DIR.mkdir(parents=True, exist_ok=True)
+        ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        payload = dict(result)
+        payload["provenance"] = (
+            f"auto-persisted by bench.py at {ts} (relay live); "
+            "fallback source if the relay is down at a later round close")
+        (RESULTS_DIR / f"session_auto_{ts}.json").write_text(
+            json.dumps(payload, indent=1) + "\n")
+    except OSError as e:
+        print(f"[persist] failed: {e}", file=sys.stderr)
 
 
 def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
@@ -270,17 +343,21 @@ def main() -> None:
 
     value = rows / tpu_dt
     baseline = rows / cpu_dt
-    print(
-        json.dumps(
-            {
-                "metric": f"tpch_q1_sf{SF}_rows_per_sec",
-                "value": round(value, 1),
-                "unit": "rows/s/chip",
-                "vs_baseline": round(value / baseline, 3),
-                "configs": configs,
-            }
-        )
-    )
+    result = {
+        "metric": f"tpch_q1_sf{SF}_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(value / baseline, 3),
+        "configs": configs,
+    }
+    try:
+        import jax
+
+        platform = jax.devices()[0].platform
+    except Exception:
+        platform = "unknown"
+    _persist_capture({**result, "platform": platform})
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

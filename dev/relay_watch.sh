@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll the TPU relay; the moment it answers, run a full bench capture and
+# exit.  Relay windows are scarce (observed: live <1h at a time) — evidence
+# capture must not wait for a human.  bench.py auto-persists the result to
+# benchmarks/results/session_auto_*.json, so this script's stdout is
+# best-effort only.
+cd /root/repo || exit 1
+mkdir -p benchmarks/results
+while true; do
+  if timeout 35 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) relay LIVE — starting capture"
+    BENCH_PROBE_BUDGET=60 BENCH_MAX_SECONDS=4800 timeout 7200 \
+      python bench.py \
+      > benchmarks/results/watch_capture.out \
+      2> benchmarks/results/watch_capture.err
+    echo "$(date -u +%FT%TZ) capture done rc=$?"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) relay down"
+  sleep 240
+done

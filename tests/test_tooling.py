@@ -5,6 +5,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import pyarrow as pa
 import pytest
@@ -162,3 +163,67 @@ def test_tpch_cli_benchmark(tmp_path):
     assert out.returncode == 0, out.stderr[-500:]
     result = json.loads(out.stdout)
     assert "q6" in result and result["q6"]["rows"] == 1
+
+
+def test_bench_stale_capture_fallback(tmp_path, monkeypatch, capsys):
+    """When the device probe budget exhausts, bench.py must emit the newest
+    persisted session capture marked stale (exit 0), never a null record
+    (VERDICT r3 #1; the reference harness always yields a record,
+    rust/benchmarks/tpch/src/main.rs:117-183)."""
+    import importlib
+
+    bench = importlib.import_module("bench")
+    monkeypatch.setattr(bench, "RESULTS_DIR", tmp_path)
+
+    # no captures at all -> returns without exiting (caller then exits 3)
+    bench._emit_stale_capture(probe_error="dead relay")
+    assert capsys.readouterr().out == ""
+
+    old = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
+           "configs": [{"name": "q1"}]}
+    new = {"metric": "m", "value": 2.0, "unit": "u", "vs_baseline": 2.0,
+           "configs": [{"name": "q1"}, {"name": "q3"}]}
+    (tmp_path / "session_a.json").write_text(json.dumps(old))
+    (tmp_path / "session_broken.json").write_text("{not json")
+    p_new = tmp_path / "session_b.json"
+    p_new.write_text(json.dumps(new))
+    now = time.time()
+    os.utime(tmp_path / "session_a.json", (now - 100, now - 100))
+    os.utime(tmp_path / "session_broken.json", (now + 10, now + 10))
+    os.utime(p_new, (now, now))
+
+    # newest *parseable* capture wins; broken JSON is skipped
+    path, d = bench._latest_session_capture()
+    assert path == p_new and d["value"] == 2.0
+
+    # a CPU-jax capture must never stand in for device evidence
+    p_cpu = tmp_path / "session_cpu.json"
+    p_cpu.write_text(json.dumps({**new, "value": 9.0, "platform": "cpu"}))
+    os.utime(p_cpu, (now + 20, now + 20))
+    path, d = bench._latest_session_capture()
+    assert path == p_new and d["value"] == 2.0
+
+    with pytest.raises(SystemExit) as ei:
+        bench._emit_stale_capture(probe_error="dead relay")
+    assert ei.value.code == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["stale"] is True
+    assert out["value"] == 2.0
+    assert out["probe_error"] == "dead relay"
+    assert out["configs"] == new["configs"]
+    assert "captured_at" in out and "capture_file" in out
+
+
+def test_bench_persist_capture(tmp_path, monkeypatch):
+    import importlib
+
+    bench = importlib.import_module("bench")
+    monkeypatch.setattr(bench, "RESULTS_DIR", tmp_path / "results")
+    bench._persist_capture({"metric": "m", "value": 3.0})
+    files = list((tmp_path / "results").glob("session_auto_*.json"))
+    assert len(files) == 1
+    d = json.loads(files[0].read_text())
+    assert d["value"] == 3.0 and "provenance" in d
+    # and the persisted file round-trips through the fallback scanner
+    path, got = bench._latest_session_capture()
+    assert path == files[0] and got["value"] == 3.0
