@@ -91,10 +91,11 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     # partial/final host path at exactly the scale the ≥5x target names
     BALLISTA_TPU_COALESCE_MAX: str(24 << 30),
     BALLISTA_TPU_SORTED_KERNEL: "layout",
-    # default under the user cache dir so warm starts survive process AND
-    # session restarts; "" disables persistence entirely
-    BALLISTA_TPU_LAYOUT_CACHE_DIR: "~/.cache/ballista_tpu/layouts",
-    BALLISTA_TPU_LAYOUT_CACHE_CAP: str(64 << 30),
+    # cwd-relative by default (like .pytest_cache) so warm starts survive
+    # process restarts without writing outside the working tree; set an
+    # absolute path for daemons with volatile cwds, "" disables persistence
+    BALLISTA_TPU_LAYOUT_CACHE_DIR: ".ballista_cache/layouts",
+    BALLISTA_TPU_LAYOUT_CACHE_CAP: str(48 << 30),
     BALLISTA_DATA_ROOTS: "",
 }
 

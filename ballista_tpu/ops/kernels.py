@@ -136,6 +136,14 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
                 built = FusedAggregateStage(exec_node)
         except UnsupportedOnDevice:
             built = False
+        # persisted-layout eligibility: only file-backed stages (memory-scan
+        # keys embed id(), which another process could recycle for different
+        # data — a false disk hit would be silent corruption)
+        if built is not False and not pinned:
+            built.persist_key = key
+            inner = getattr(built, "inner", None)
+            if inner is not None:
+                inner.persist_key = key
         with _stage_cache_lock:
             stage = _stage_cache.get(key)
             if stage is None:

@@ -146,13 +146,43 @@ def load_entry(
             i += 1
         if i != meta.get("n_arrays", i):
             return None
-        os.utime(d)  # LRU recency for _evict_to_cap
+        try:
+            os.utime(d)  # LRU recency for _evict_to_cap
+        except OSError:
+            pass  # read-only cache: the hit still counts
         return meta, arrays
     except Exception:
         return None
 
 
 # -- (de)hydration helpers for the stage entry shapes -----------------------
+
+def pack_arrow_arrays(arrays_pa) -> np.ndarray:
+    """Serialize a list of equal-length Arrow arrays (group key values — any
+    Arrow type: strings, dates, decimals) as one uint8 IPC-file buffer, so
+    they ride the numpy-only entry format unchanged."""
+    import pyarrow as pa
+
+    cols = {}
+    for i, kv in enumerate(arrays_pa):
+        if isinstance(kv, pa.ChunkedArray):
+            kv = kv.combine_chunks()
+        elif not isinstance(kv, pa.Array):
+            kv = pa.array(kv)
+        cols[f"k{i}"] = kv
+    table = pa.table(cols) if cols else pa.table({})
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_file(sink, table.schema) as w:
+        w.write_table(table)
+    return np.frombuffer(sink.getvalue(), dtype=np.uint8).copy()
+
+
+def unpack_arrow_arrays(buf: np.ndarray) -> List:
+    import pyarrow as pa
+
+    table = pa.ipc.open_file(pa.BufferReader(buf.tobytes())).read_all()
+    return [table.column(i).combine_chunks() for i in range(table.num_columns)]
+
 
 def pack_dict_snapshot(dicts) -> Tuple[dict, List[np.ndarray]]:
     """Snapshot a ScanDictionaries registry as (meta, arrays). String codes

@@ -314,6 +314,10 @@ class FusedAggregateStage:
         # [V, L1] tiles alongside the scan columns on the sorted path
         # (FactAggregateStage derives static mapped columns this way)
         self.derive_columns: Dict[str, Callable] = {}
+        # stage cache key (plan display + scan files + mtimes + config
+        # flags), set by kernels.hash_aggregate for file-backed stages only;
+        # keys the persisted layout cache (ops/layout_cache.py)
+        self.persist_key: Optional[str] = None
 
     @staticmethod
     def _partial_schema(agg) -> pa.Schema:
@@ -683,11 +687,18 @@ class FusedAggregateStage:
         (ops/layout.py). Sorting/ranking/materialization is cache-time host
         work; per-query device work is O(N) elementwise + axis reductions.
         Config ballista.tpu.sorted_kernel=pallas selects the MXU one-hot
-        matmul kernel instead (sum/count/avg only)."""
-        import jax.numpy as jnp
+        matmul kernel instead (sum/count/avg only).
 
+        The host work (parquet decode, encode, rank, sort, materialize) is a
+        pure function of (persist_key, partition) — persisted via
+        ops/layout_cache.py so a fresh process skips straight to the h2d
+        transfer (measured: it is ~600 of the 737 s of a cold q3 SF=100).
+        The pallas kernel path is not persisted (config-gated, flat layout)."""
         from ballista_tpu.ops.layout import SortedSegmentLayout
 
+        loaded = self._load_sorted_layout(partition, ctx)
+        if loaded is not None:
+            return loaded
         batches = [b for b in self._scan_batches(partition, ctx) if b.num_rows]
         if not batches:
             return {"kind": "empty"}
@@ -739,6 +750,29 @@ class FusedAggregateStage:
             total += staged_derived[name][0].nbytes
         budget = ctx.config.tpu_hbm_budget()
         if total > budget:
+            # checked BEFORE persisting so an undeployable layout is never
+            # written to disk
+            raise UnsupportedOnDevice(
+                f"stage tiles ({total >> 20} MiB) exceed the HBM budget"
+            )
+        # persist BEFORE upload: _upload_staged consumes the host tiles
+        self._save_sorted_layout(
+            partition, ctx, layout, staged, staged_derived, key_values
+        )
+        return self._finish_sorted(
+            ctx, layout, staged, staged_derived, key_values, total
+        )
+
+    def _finish_sorted(
+        self, ctx, layout, staged: Dict, staged_derived: Dict, key_values,
+        total: int,
+    ) -> dict:
+        """Shared tail of the fresh and disk-loaded sorted prepares: budget
+        check, headroom, h2d upload, derived upload, step build, entry."""
+        import jax.numpy as jnp
+
+        budget = ctx.config.tpu_hbm_budget()
+        if total > budget:
             raise UnsupportedOnDevice(
                 f"stage tiles ({total >> 20} MiB) exceed the HBM budget"
             )
@@ -756,11 +790,117 @@ class FusedAggregateStage:
             "kind": "sorted",
             "layout": layout,
             "cols": cols,
-            "pad": jnp.asarray(layout.pad),
+            "pad": jnp.asarray(np.ascontiguousarray(layout.pad)),
             "key_values": key_values,
-            "n_groups": n_groups,
+            "n_groups": layout.n_groups,
             "derived": derived,
         }
+
+    # -- persisted layout cache (ops/layout_cache.py) -------------------
+    def _save_sorted_layout(
+        self, partition: int, ctx, layout, staged: Dict, staged_derived: Dict,
+        key_values,
+    ) -> None:
+        """Best-effort persist of one prepared sorted partition: layout
+        scalars + owner/pad, narrow tiles + LUTs + choices, derived tiles,
+        the string-dictionary snapshot (codes baked into the tiles), and the
+        group key values (Arrow IPC bytes). Entries are keyed by the stage
+        cache key, so file rewrites and config changes miss cleanly; the
+        int-range check is NOT re-run on load because the entry only exists
+        if the identical data passed it at save time."""
+        base = ctx.config.tpu_layout_cache_dir()
+        if not base or self.persist_key is None:
+            return
+        from ballista_tpu.ops import layout_cache as lc
+
+        arrays: List[np.ndarray] = []
+        meta: Dict = {"kind": "sorted", "layout": layout.state()}
+        meta["owner"] = len(arrays)
+        arrays.append(layout.owner)
+        meta["pad"] = len(arrays)
+        arrays.append(layout.pad)
+        cols_meta = {}
+        for idx, (tiles, lut, choice) in staged.items():
+            spec = {"tiles": len(arrays), "choice": choice, "lut": None}
+            arrays.append(tiles)
+            if lut is not None:
+                spec["lut"] = len(arrays)
+                arrays.append(lut)
+            cols_meta[str(idx)] = spec
+        meta["cols"] = cols_meta
+        derived_meta = {}
+        for name, (tiles, nkey, choice) in staged_derived.items():
+            derived_meta[name] = {
+                "tiles": len(arrays), "key": nkey, "choice": choice,
+            }
+            arrays.append(tiles)
+        meta["derived"] = derived_meta
+        dmeta, darrays = lc.pack_dict_snapshot(self.dicts)
+        offset = len(arrays)
+        meta["dicts"] = {k: v + offset for k, v in dmeta.items()}
+        arrays.extend(darrays)
+        meta["keys"] = len(arrays)
+        arrays.append(lc.pack_arrow_arrays(key_values))
+        meta["n_arrays"] = len(arrays)
+        lc.save_entry(
+            base, self.persist_key, partition, meta, arrays,
+            ctx.config.tpu_layout_cache_cap(),
+        )
+
+    def _load_sorted_layout(self, partition: int, ctx) -> Optional[dict]:
+        """Rehydrate a persisted sorted partition: adopt the dictionary
+        snapshot (live dicts must be a prefix — codes in the tiles must mean
+        the same strings), rebuild the layout from its scalars, and go
+        straight to the h2d transfer. Returns None on any miss/mismatch."""
+        base = ctx.config.tpu_layout_cache_dir()
+        if not base or self.persist_key is None:
+            return None
+        from ballista_tpu.ops import layout_cache as lc
+
+        hit = lc.load_entry(base, self.persist_key, partition)
+        if hit is None:
+            return None
+        meta, arrays = hit
+        if meta.get("kind") != "sorted":
+            return None
+        if set(meta.get("derived", {})) != set(self.derive_columns):
+            return None
+        try:
+            if not lc.adopt_dict_snapshot(self.dicts, meta["dicts"], arrays):
+                return None
+            from ballista_tpu.ops.layout import SortedSegmentLayout
+
+            owner = arrays[meta["owner"]]
+            pad = arrays[meta["pad"]]
+            layout = SortedSegmentLayout.from_state(meta["layout"], owner, pad)
+            staged: Dict[int, tuple] = {}
+            total = pad.nbytes
+            for k, spec in meta["cols"].items():
+                idx = int(k)
+                tiles = arrays[spec["tiles"]]
+                lut = arrays[spec["lut"]] if spec["lut"] is not None else None
+                cur = self._narrow_choice.get(idx)
+                if cur is not None and cur != spec["choice"]:
+                    return None  # jitted step already compiled another dtype
+                staged[idx] = (tiles, lut, spec["choice"])
+                total += tiles.nbytes + (0 if lut is None else lut.nbytes)
+            staged_derived: Dict[str, tuple] = {}
+            for name, spec in meta["derived"].items():
+                nkey = spec["key"]
+                if nkey is not None:
+                    cur = self._narrow_choice.get(nkey)
+                    if cur is not None and cur != spec["choice"]:
+                        return None
+                staged_derived[name] = (arrays[spec["tiles"]], nkey, spec["choice"])
+                total += arrays[spec["tiles"]].nbytes
+            key_values = lc.unpack_arrow_arrays(arrays[meta["keys"]])
+        except Exception:
+            return None
+        # budget overrun raises (not miss): same disposition as a fresh
+        # prepare of this partition
+        return self._finish_sorted(
+            ctx, layout, staged, staged_derived, key_values, total
+        )
 
     def _prepare_pallas_sorted(self, batch, codes, key_values, n_groups, ctx) -> dict:
         """Flat sorted residency for the pallas MXU kernel
@@ -874,28 +1014,39 @@ class FusedAggregateStage:
         if prepared is None:
             with self._prepare_lock:
                 prepared = self._device_cache.get(partition) if use_cache else None
+                freshly_prepared = False
+                if prepared is None:
+                    # persisted sorted layout first: a hit skips the whole
+                    # scan+rank pass (the unrolled path would decode parquet
+                    # before discovering the cardinality it declines on)
+                    prepared = self._load_sorted_layout(partition, ctx)
+                    freshly_prepared = prepared is not None
                 if prepared is None:
                     try:
                         prepared = {"kind": "batches",
                                     "entries": self._prepare_partition(partition, ctx)}
                     except TooManyGroups:
                         prepared = self._prepare_partition_sorted(partition, ctx)
-                    if use_cache:
-                        from ballista_tpu.ops.runtime import (
-                            entry_device_bytes,
-                            reserve_and_pin,
-                        )
+                    freshly_prepared = True
+                if freshly_prepared and use_cache:
+                    from ballista_tpu.ops.runtime import (
+                        entry_device_bytes,
+                        reserve_and_pin,
+                    )
 
-                        # pin only within the HBM budget; partitions beyond
-                        # it stream per query (how SF=100 fits a 16GB chip)
-                        reserve_and_pin(
-                            self,
-                            partition,
-                            prepared,
-                            self._device_cache,
-                            entry_device_bytes(prepared),
-                            ctx.config.tpu_hbm_budget(),
-                        )
+                    # pin only within the HBM budget; partitions beyond
+                    # it stream per query (how SF=100 fits a 16GB chip).
+                    # Disk-loaded entries pin too — an unpinned hit would
+                    # re-read the multi-GB entry per query AND hold device
+                    # arrays the residency ledger never accounted for.
+                    reserve_and_pin(
+                        self,
+                        partition,
+                        prepared,
+                        self._device_cache,
+                        entry_device_bytes(prepared),
+                        ctx.config.tpu_hbm_budget(),
+                    )
 
         aux = [jnp.asarray(a) for a in self.compiler.build_aux()]
         if prepared["kind"] == "empty":

@@ -1,0 +1,60 @@
+"""Pre-populate the persisted device-layout cache for bench.py's configs.
+
+Runs each bench config's device-backend side ONCE on CPU jax (the host
+prepare — decode, encode, rank, sort, materialize, narrow — is identical on
+any jax platform, and the persisted artifact is host-side numpy), so a later
+relay-attached bench run skips straight to the h2d transfer. Holds
+/tmp/ballista_prepop.lock while running; dev/relay_watch.sh waits on it so a
+live-relay capture never shares the machine with this scan-heavy job.
+
+Usage: run from the repo root with the relay-free CPU env:
+  env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
+      JAX_PLATFORMS=cpu python dev/prepopulate_layouts.py
+"""
+
+import os
+import pathlib
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+os.chdir(REPO)  # the layout-cache default dir is cwd-relative
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+LOCK = pathlib.Path("/tmp/ballista_prepop.lock")
+
+
+def main() -> None:
+    LOCK.write_text(str(os.getpid()))
+    try:
+        import bench
+
+        for sf, name in bench.CONFIGS:
+            try:
+                from benchmarks.tpch.datagen import is_complete
+
+                if not is_complete(str(bench.data_dir(sf))):
+                    print(f"[prepop] {name} sf={sf}: dataset absent, skipped",
+                          flush=True)
+                    continue
+                sql = (bench.QUERIES_DIR / f"{name}.sql").read_text()
+                t0 = time.monotonic()
+                bench.run_once("tpu", sql, sf)
+                print(f"[prepop] {name} sf={sf}: {time.monotonic()-t0:.1f}s",
+                      flush=True)
+            except Exception as e:
+                print(f"[prepop] {name} sf={sf}: failed: {e}", flush=True)
+    finally:
+        LOCK.unlink(missing_ok=True)
+
+
+if __name__ == "__main__":
+    main()

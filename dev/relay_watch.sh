@@ -9,11 +9,22 @@ mkdir -p benchmarks/results
 while true; do
   if timeout 35 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) relay LIVE — starting capture"
+    while [ -f /tmp/ballista_prepop.lock ]; do
+      pid=$(cat /tmp/ballista_prepop.lock 2>/dev/null)
+      if [ -z "$pid" ] || ! kill -0 "$pid" 2>/dev/null; then
+        echo "stale prepopulation lock (pid ${pid:-?} gone) — proceeding"
+        rm -f /tmp/ballista_prepop.lock
+        break
+      fi
+      echo "waiting for layout prepopulation (pid $pid) to finish"
+      sleep 30
+    done
     BENCH_PROBE_BUDGET=60 BENCH_MAX_SECONDS=4800 timeout 7200 \
       python bench.py \
       > benchmarks/results/watch_capture.out \
       2> benchmarks/results/watch_capture.err
-    echo "$(date -u +%FT%TZ) capture done rc=$?"
+    rc=$?
+    echo "$(date -u +%FT%TZ) capture done rc=$rc"
     exit 0
   fi
   echo "$(date -u +%FT%TZ) relay down"

@@ -29,6 +29,13 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 import pyarrow as pa
 import pytest
 
+# The persisted layout cache defaults to a cwd-relative directory; tests
+# must not leave cache trees in the working copy (cache-specific tests pass
+# an explicit tmp dir instead).
+import ballista_tpu.config as _config
+
+_config.DEFAULT_SETTINGS[_config.BALLISTA_TPU_LAYOUT_CACHE_DIR] = ""
+
 
 @pytest.fixture
 def sales_table() -> pa.Table:
