@@ -34,6 +34,7 @@ import json
 import os
 import shutil
 import tempfile
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -54,6 +55,12 @@ def _write_arrays(d: str, arrays: List[np.ndarray]) -> List[int]:
     return ids
 
 
+# in-flight write dirs carry this prefix so eviction never deletes them
+# while live; ones untouched this long are crashed writers' orphans
+_TMP_PREFIX = ".wip-"
+_WIP_ORPHAN_S = 6 * 3600.0  # > any plausible single-entry write
+
+
 def _dir_bytes(base: str) -> int:
     total = 0
     for root, _dirs, files in os.walk(base):
@@ -65,12 +72,34 @@ def _dir_bytes(base: str) -> int:
     return total
 
 
+# per-process running total per cache base: a full os.walk of a ~48 GiB tree
+# per save is O(entries^2) stat traffic as the cache fills. The estimate is
+# refreshed with a real walk only when it says the cap is exceeded (other
+# processes' writes are invisible until then — the cap stays best-effort).
+import threading as _threading
+
+_size_lock = _threading.Lock()
+_size_cache: Dict[str, int] = {}
+
+
+def _size_note(base: str, delta: int) -> None:
+    with _size_lock:
+        if base in _size_cache:
+            _size_cache[base] = max(0, _size_cache[base] + delta)
+
+
 def _evict_to_cap(base: str, incoming: int, cap: int) -> bool:
     """Evict oldest entry dirs until `incoming` fits under `cap`.
     Returns False when it cannot fit (entry bigger than the whole cap)."""
     if incoming > cap:
         return False
-    total = _dir_bytes(base)
+    with _size_lock:
+        total = _size_cache.get(base)
+    if total is not None and total + incoming <= cap:
+        return True
+    total = _dir_bytes(base)  # estimate says over-cap (or unknown): re-walk
+    with _size_lock:
+        _size_cache[base] = total
     if total + incoming <= cap:
         return True
     entries = []
@@ -80,17 +109,30 @@ def _evict_to_cap(base: str, incoming: int, cap: int) -> bool:
             continue
         for name in os.listdir(sp):
             p = os.path.join(sp, name)
-            if os.path.isdir(p):
+            if not os.path.isdir(p):
+                continue
+            if name.startswith(_TMP_PREFIX):
+                # a LIVE writer's in-flight tmpdir must not be evicted —
+                # rmtree mid-write would silently drop the ~600s prepare it
+                # is persisting. A crashed writer's orphan, however, would
+                # consume the cap forever; reclaim once clearly abandoned.
                 try:
-                    entries.append((os.path.getmtime(p), p, _dir_bytes(p)))
+                    if time.time() - os.path.getmtime(p) > _WIP_ORPHAN_S:
+                        shutil.rmtree(p, ignore_errors=True)
                 except OSError:
                     pass
+                continue
+            try:
+                entries.append((os.path.getmtime(p), p, _dir_bytes(p)))
+            except OSError:
+                pass
     entries.sort()
     for _mtime, p, nbytes in entries:
         if total + incoming <= cap:
             break
         shutil.rmtree(p, ignore_errors=True)
         total -= nbytes
+        _size_note(base, -nbytes)
     return total + incoming <= cap
 
 
@@ -113,13 +155,14 @@ def save_entry(
         os.makedirs(os.path.dirname(target), exist_ok=True)
         if not _evict_to_cap(base, incoming, cap_bytes):
             return
-        tmp = tempfile.mkdtemp(dir=os.path.dirname(target))
+        tmp = tempfile.mkdtemp(dir=os.path.dirname(target), prefix=_TMP_PREFIX)
         try:
             _write_arrays(tmp, arrays)
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"format": _FORMAT, **meta}, f)
             try:
                 os.rename(tmp, target)
+                _size_note(base, incoming)
             except OSError:  # raced with another writer: keep theirs
                 shutil.rmtree(tmp, ignore_errors=True)
         except BaseException:

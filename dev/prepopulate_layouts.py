@@ -32,8 +32,33 @@ jax.config.update("jax_platforms", "cpu")
 LOCK = pathlib.Path("/tmp/ballista_prepop.lock")
 
 
+def _acquire_lock() -> bool:
+    """Exclusive-create the lock; a live holder wins, a dead one is replaced."""
+    while True:
+        try:
+            fd = os.open(LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            with os.fdopen(fd, "w") as f:
+                f.write(str(os.getpid()))
+            return True
+        except FileExistsError:
+            try:
+                pid = int(LOCK.read_text().strip() or "0")
+            except (OSError, ValueError):
+                pid = 0
+            if pid > 0:
+                try:
+                    os.kill(pid, 0)
+                    print(f"[prepop] another instance (pid {pid}) is running",
+                          flush=True)
+                    return False
+                except ProcessLookupError:
+                    pass
+            LOCK.unlink(missing_ok=True)  # stale: retry the exclusive create
+
+
 def main() -> None:
-    LOCK.write_text(str(os.getpid()))
+    if not _acquire_lock():
+        return
     try:
         import bench
 

@@ -3,7 +3,8 @@
 # exit.  Relay windows are scarce (observed: live <1h at a time) — evidence
 # capture must not wait for a human.  bench.py auto-persists the result to
 # benchmarks/results/session_auto_*.json, so this script's stdout is
-# best-effort only.
+# best-effort only.  A capture that only emitted the stale fallback (relay
+# dropped between probe and bench) does NOT count: keep watching.
 cd /root/repo || exit 1
 mkdir -p benchmarks/results
 while true; do
@@ -25,8 +26,13 @@ while true; do
       2> benchmarks/results/watch_capture.err
     rc=$?
     echo "$(date -u +%FT%TZ) capture done rc=$rc"
-    exit 0
+    if [ "$rc" -eq 0 ] && ! grep -q '"stale": true' benchmarks/results/watch_capture.out; then
+      echo "fresh capture recorded"
+      exit 0
+    fi
+    echo "no fresh capture (rc=$rc, possibly stale fallback) — keep watching"
+  else
+    echo "$(date -u +%FT%TZ) relay down"
   fi
-  echo "$(date -u +%FT%TZ) relay down"
   sleep 240
 done

@@ -243,3 +243,77 @@ class TestAssignment:
         second = s.assign_next_schedulable_task("e1")
         if second is not None:
             assert second[0].partition_id.stage_id == stages[0].stage_id
+
+
+def test_real_etcd_if_available():
+    """KvBackend contract against a REAL etcd daemon. The image bakes
+    neither an etcd binary nor an etcd3 client (PARITY.md disposition), so
+    this skips here — a CI with etcd on PATH runs the same lease/prefix/
+    lock contract the fake is held to (reference dials real etcd in
+    rust/benchmarks/tpch/docker-compose.yaml:1-43)."""
+    import shutil
+
+    if shutil.which("etcd") is None:
+        pytest.skip("no etcd binary in image")
+    # the fixture tests install tests/fake_etcd3 under sys.modules["etcd3"];
+    # evict it so both this gate and EtcdBackend.__init__ resolve the REAL
+    # client — otherwise this test would pass vacuously against the fake
+    saved = sys.modules.pop("etcd3", None)
+    if saved is not None and "fake" not in getattr(saved, "__name__", ""):
+        sys.modules["etcd3"] = saved  # a real client was already imported
+        saved = None
+    try:
+        try:
+            import etcd3
+        except ImportError:
+            pytest.skip("no etcd3 client library in image")
+        assert "fake" not in etcd3.__name__
+        _run_real_etcd_contract()
+    finally:
+        if saved is not None:
+            sys.modules["etcd3"] = saved
+
+
+def _run_real_etcd_contract():
+    import socket
+    import subprocess
+    import tempfile
+    import time as _time
+
+    with socket.socket() as s:  # a free port, not a hardcoded one
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    url = f"http://127.0.0.1:{port}"
+    with tempfile.TemporaryDirectory() as d:
+        proc = subprocess.Popen(
+            ["etcd", "--data-dir", d,
+             "--listen-client-urls", url,
+             "--advertise-client-urls", url],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            # readiness poll: a loaded CI host can take >2s to serve
+            deadline = _time.monotonic() + 30
+            kv = None
+            while True:
+                if proc.poll() is not None:
+                    pytest.skip(f"etcd exited rc={proc.returncode} at startup")
+                try:
+                    kv = EtcdBackend(f"127.0.0.1:{port}")
+                    kv.get("/ballista/ready")
+                    break
+                except Exception:
+                    if _time.monotonic() > deadline:
+                        raise
+                    _time.sleep(0.25)
+            kv.put("/ballista/x", b"1")
+            assert kv.get("/ballista/x") == b"1"
+            kv.put("/ballista/y", b"2")
+            assert [k for k, _ in kv.get_prefix("/ballista/")] == [
+                "/ballista/x", "/ballista/y",
+            ]
+            with kv.lock():
+                pass
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
