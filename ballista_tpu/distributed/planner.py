@@ -50,13 +50,23 @@ class DistributedPlanner:
         return stages
 
     def _fuse_spmd_aggregates(self, node: ExecutionPlan) -> ExecutionPlan:
-        """Config-gated TPU restructuring (SURVEY §7 step 5): a
-        HashAggregate(Final) <- Repartition(hash) <- HashAggregate(Partial)
-        subtree — which the exchange rule below would split into two stages
-        plus a materialized shuffle — becomes ONE SpmdAggregateExec stage
-        whose exchange is a psum over the device mesh."""
+        """Config-gated TPU restructuring (SURVEY §7 step 5):
+
+        - a HashAggregate(Final) <- Repartition(hash) <- HashAggregate(
+          Partial) subtree — which the exchange rule below would split into
+          two stages plus a materialized shuffle — becomes ONE
+          SpmdAggregateExec stage whose exchange is a psum over the mesh;
+        - a co-partitionable HashJoin (INNER/LEFT, no residual filter)
+          becomes ONE SpmdJoinExec stage whose hash exchange is
+          lax.all_to_all over the mesh (SURVEY §2.8's RepartitionExec
+          mapping) instead of two materialized shuffles.
+
+        Both keep the untouched subtree inside for serde + host fallback."""
+        from ballista_tpu.logical.plan import JoinType
+        from ballista_tpu.parallel.spmd_join import SpmdJoinExec
         from ballista_tpu.parallel.spmd_stage import SpmdAggregateExec
         from ballista_tpu.physical.aggregate import AggregateMode, HashAggregateExec
+        from ballista_tpu.physical.join import HashJoinExec
 
         children = [self._fuse_spmd_aggregates(c) for c in node.children()]
         if children:
@@ -69,6 +79,13 @@ class DistributedPlanner:
             and node.input.input.mode == AggregateMode.PARTIAL
         ):
             return SpmdAggregateExec(node)
+        if (
+            isinstance(node, HashJoinExec)
+            and node.partitioned  # only fuse when there IS an exchange pair
+            and node.join_type in (JoinType.INNER, JoinType.LEFT)
+            and node.filter is None
+        ):
+            return SpmdJoinExec(node)
         return node
 
     def _visit(

@@ -97,6 +97,7 @@ class ExecutionContext:
             batch_size=self.config.batch_size(),
             coalesce_aggregates=self.config.tpu_coalesce_aggregates(),
             coalesce_max_bytes=self.config.tpu_coalesce_max_bytes(),
+            spmd_joins=self.config.tpu_spmd(),
         )
         return planner.create_physical_plan(self.optimize(plan))
 

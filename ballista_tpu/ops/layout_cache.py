@@ -62,8 +62,14 @@ _WIP_ORPHAN_S = 6 * 3600.0  # > any plausible single-entry write
 
 
 def _dir_bytes(base: str) -> int:
+    """Committed bytes under base. In-flight .wip- writer dirs are excluded:
+    they are not evictable, so counting them against the cap would let one
+    concurrent large write force eviction of every committed entry and still
+    decline the incoming save (the cap is best-effort and transient
+    overshoot while writers finish is the lesser harm)."""
     total = 0
-    for root, _dirs, files in os.walk(base):
+    for root, dirs, files in os.walk(base):
+        dirs[:] = [d for d in dirs if not d.startswith(_TMP_PREFIX)]
         for f in files:
             try:
                 total += os.path.getsize(os.path.join(root, f))
@@ -115,7 +121,8 @@ def _evict_to_cap(base: str, incoming: int, cap: int) -> bool:
                 # a LIVE writer's in-flight tmpdir must not be evicted —
                 # rmtree mid-write would silently drop the ~600s prepare it
                 # is persisting. A crashed writer's orphan, however, would
-                # consume the cap forever; reclaim once clearly abandoned.
+                # hold disk forever; reclaim once clearly abandoned. (wip
+                # bytes are excluded from `total`, so no cap adjustment.)
                 try:
                     if time.time() - os.path.getmtime(p) > _WIP_ORPHAN_S:
                         shutil.rmtree(p, ignore_errors=True)

@@ -1,0 +1,302 @@
+"""SPMD co-partitioned join: the hash-repartition exchange as ONE mesh
+program over ICI.
+
+The reference feeds a partitioned join through two materialized hash
+shuffles (RepartitionExec -> ShuffleWriter/Reader pairs,
+rust/core/proto/ballista.proto:415-422, rust/scheduler/src/planner.rs:114-148)
+and joins partition pairs on the CPU. The TPU-native restructuring (SURVEY
+§2.8's RepartitionExec -> lax.all_to_all mapping): key-hash buckets are
+exchanged between mesh shards with `lax.all_to_all` inside one shard_map
+program, and each shard matches its key range with sort + searchsorted —
+the same regular, scatter-free shape the device join kernel uses
+(ops/join.py).
+
+What travels over the mesh is (dense key code, row id) per side — the
+matching plane. Payload columns do NOT ride the ICI exchange: on a
+single-host mesh every payload row is already host-local, so the final
+assembly is a zero-copy Arrow take on the matched row-id pairs the program
+returns (sending payloads through the chip would add two transfers for
+data the host already holds). On a multi-host pod the payload legs ride
+the host data plane (Arrow Flight, client/flight.py) exactly like the
+reference's shuffle pieces; the ICI program still eliminates the
+materialize-sort-merge of the key-matching plane.
+
+Key coding is shared with the host join (physical/joinutil.py): any Arrow
+key type, composite keys, nulls -> -1 (never match). Coding is dense, so
+bucket ownership `splitmix(code) % n_dev` balances shards and codes fit
+int32 for the device sort.
+
+Decline-to-host (the wrapped subplan is the untouched original subtree):
+non-INNER/LEFT join types, residual filters, duplicate non-null build
+keys (searchsorted yields one match; many-many multiplicity needs the
+host expansion), or any device error.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ballista_tpu.logical.plan import JoinType
+from ballista_tpu.physical.plan import (
+    ExecutionPlan,
+    Partitioning,
+    TaskContext,
+    batch_table,
+    collect_all,
+)
+from ballista_tpu.physical.repartition import RepartitionExec, _splitmix64
+
+
+def _strip_repartition(node: ExecutionPlan) -> ExecutionPlan:
+    """The mesh program IS the exchange: read the repartition's input."""
+    return node.input if isinstance(node, RepartitionExec) else node
+
+
+class SpmdJoinExec(ExecutionPlan):
+    """Executes HashJoin(Repartition(L), Repartition(R)) as one mesh program.
+
+    Mirrors SpmdAggregateExec's contract: single output partition, the
+    wrapped subplan serialized whole (serde + host fallback), `last_path`
+    records whether the mesh actually ran.
+    """
+
+    def __init__(self, subplan) -> None:
+        from ballista_tpu.physical.join import HashJoinExec
+
+        assert isinstance(subplan, HashJoinExec)
+        self.subplan = subplan  # the HashJoinExec, kept whole for serde
+        self._mesh = None
+        self._program = None
+        self._program_key = None
+        self.last_path: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    def schema(self) -> pa.Schema:
+        return self.subplan.schema()
+
+    def output_partitioning(self) -> Partitioning:
+        return Partitioning.unknown(1)
+
+    def children(self) -> List[ExecutionPlan]:
+        return []  # serialized/traversed whole; must stay one stage
+
+    def with_children(self, children: List[ExecutionPlan]) -> "SpmdJoinExec":
+        assert not children
+        return self
+
+    def fmt(self) -> str:
+        on = ", ".join(f"{l} = {r}" for l, r in self.subplan.on)
+        return (
+            f"SpmdJoinExec: type={self.subplan.join_type.value}, on=[{on}], "
+            "all_to_all exchange as one mesh program"
+        )
+
+    # ------------------------------------------------------------------
+    def _build_mesh(self, ctx: TaskContext):
+        import jax
+
+        from ballista_tpu.parallel.mesh import build_mesh
+
+        if self._mesh is not None:
+            return self._mesh
+        shape = ctx.config.mesh_shape() or None
+        try:
+            self._mesh = build_mesh(shape)
+        except ValueError:
+            self._mesh = build_mesh({"data": len(jax.devices())})
+        return self._mesh
+
+    def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        from ballista_tpu.utils import tracing
+
+        assert partition == 0
+        if ctx.backend != "tpu":
+            yield from self._execute_host(ctx)
+            return
+        try:
+            out = self._execute_mesh(ctx)
+            self.last_path = "mesh"
+            tracing.incr("spmd.join_mesh")
+        except Exception:
+            import logging
+            import sys
+
+            from ballista_tpu.ops.runtime import UnsupportedOnDevice
+
+            exc = sys.exc_info()[1]
+            tracing.incr("spmd.join_host_fallback")
+            if not isinstance(exc, UnsupportedOnDevice):
+                logging.getLogger("ballista.spmd").warning(
+                    "mesh join failed, host fallback: %s", exc
+                )
+            self.last_path = "host"
+            yield from self._execute_host(ctx)
+            return
+        yield from batch_table(out, ctx.batch_size)
+
+    def _execute_host(self, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
+        yield from batch_table(collect_all(self.subplan, ctx), ctx.batch_size)
+
+    # ------------------------------------------------------------------
+    def _execute_mesh(self, ctx: TaskContext) -> pa.Table:
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.runtime import UnsupportedOnDevice
+        from ballista_tpu.physical.joinutil import (
+            combined_key_codes,
+            take_table,
+        )
+        from ballista_tpu.physical.joinutil import _refactorize
+
+        join = self.subplan
+        if join.join_type not in (JoinType.INNER, JoinType.LEFT):
+            raise UnsupportedOnDevice(f"mesh join type {join.join_type.value}")
+        if join.filter is not None:
+            raise UnsupportedOnDevice("mesh join residual filter")
+
+        mesh = self._build_mesh(ctx)
+        n_dev = int(np.prod(list(mesh.shape.values())))
+
+        # the mesh replaces the hash exchange: read the repartition inputs
+        left = collect_all(_strip_repartition(join.left), ctx)
+        right = collect_all(_strip_repartition(join.right), ctx)
+        if left.num_rows == 0 or right.num_rows == 0:
+            raise UnsupportedOnDevice("empty join side")
+        if max(left.num_rows, right.num_rows) >= (1 << 31):
+            raise UnsupportedOnDevice("row ids exceed int32")
+
+        lkeys = [n for n, _ in join.on]
+        rkeys = [n for _, n in join.on]
+        bcodes, pcodes = combined_key_codes(
+            [left.column(k) for k in lkeys], [right.column(k) for k in rkeys]
+        )
+        hi = max(int(bcodes.max()), int(pcodes.max())) if len(bcodes) else 0
+        if hi >= (1 << 31):
+            # dense re-map: distinct count <= row count < 2^31
+            bcodes, pcodes, _ = _refactorize(bcodes, pcodes)
+        # searchsorted yields one match per probe: build keys must be unique
+        valid_b = bcodes >= 0
+        uniq = np.unique(bcodes[valid_b])
+        if len(uniq) != int(valid_b.sum()):
+            raise UnsupportedOnDevice("duplicate build keys (many-many join)")
+
+        # ---- host staging: bucket (code, rowid) by key ownership ------
+        def stage_side(codes: np.ndarray):
+            """Rows -> per-(source shard, dest shard) buckets, padded to a
+            common capacity C. Source shard = row % n_dev (each shard would
+            read its own partitions on a pod); dest = splitmix(code) % n_dev.
+            Returns (codes [n_dev * n_dev*C], rowids same, C)."""
+            n = len(codes)
+            src = np.arange(n, dtype=np.int64) % n_dev
+            dest = (_splitmix64(np.maximum(codes, 0)) % np.uint64(n_dev)).astype(np.int64)
+            # bucket sizes per (src, dest)
+            flat = src * n_dev + dest
+            counts = np.bincount(flat, minlength=n_dev * n_dev)
+            C = max(1, int(counts.max()))
+            B = n_dev * C
+            out_codes = np.full(n_dev * B, -1, dtype=np.int32)
+            out_rows = np.full(n_dev * B, -1, dtype=np.int32)
+            order = np.argsort(flat, kind="stable")
+            sorted_flat = flat[order]
+            starts = np.searchsorted(sorted_flat, np.arange(n_dev * n_dev))
+            ends = np.searchsorted(sorted_flat, np.arange(n_dev * n_dev), side="right")
+            for s in range(n_dev):
+                for d in range(n_dev):
+                    lo, hi_ = int(starts[s * n_dev + d]), int(ends[s * n_dev + d])
+                    rows = order[lo:hi_]
+                    base = s * B + d * C
+                    out_codes[base: base + len(rows)] = codes[rows]
+                    out_rows[base: base + len(rows)] = rows
+            return out_codes, out_rows, C
+
+        lc, lr, C_l = stage_side(bcodes)
+        pc_, pr, C_p = stage_side(pcodes)
+
+        program = self._get_program(
+            mesh, n_dev, C_l * n_dev, C_p * n_dev,
+            want_left_bitmap=join.join_type == JoinType.LEFT,
+        )
+        outs = program(
+            jnp.asarray(lc), jnp.asarray(lr), jnp.asarray(pc_), jnp.asarray(pr)
+        )
+        matched_lrow = np.asarray(outs[0])  # [n_dev * B_p] int32, -1 = no match
+        recv_prow = np.asarray(outs[1])  # [n_dev * B_p] int32, -1 = pad
+
+        pairs = (matched_lrow >= 0) & (recv_prow >= 0)
+        lidx = matched_lrow[pairs].astype(np.int64)
+        ridx = recv_prow[pairs].astype(np.int64)
+        left_out = take_table(left, lidx)
+        right_out = take_table(right, ridx)
+        if join.join_type == JoinType.LEFT:
+            lmatched = np.asarray(outs[2])  # bool over exchanged left slots
+            recv_lrow = np.asarray(outs[3])
+            un = recv_lrow[(recv_lrow >= 0) & ~lmatched].astype(np.int64)
+            if len(un):
+                left_un = take_table(left, un)
+                nulls = pa.table(
+                    [pa.nulls(len(un), type=f.type) for f in right.schema],
+                    schema=right.schema,
+                )
+                left_out = pa.concat_tables([left_out, left_un])
+                right_out = pa.concat_tables([right_out, nulls])
+        cols = list(left_out.columns) + list(right_out.columns)
+        return pa.table(cols, schema=self.schema())
+
+    # ------------------------------------------------------------------
+    def _get_program(self, mesh, n_dev: int, B_l: int, B_p: int,
+                     want_left_bitmap: bool):
+        """shard_map program, jitted once per (capacities, join shape):
+        all_to_all exchange of (code, rowid) for both sides, then per-shard
+        sort + searchsorted matching. Outputs stay sharded (P('data'));
+        every shard owns a disjoint key range, so its matches are global."""
+        key = (n_dev, B_l, B_p, want_left_bitmap)
+        if self._program_key == key:
+            return self._program
+
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def a2a(x):
+            return jax.lax.all_to_all(
+                x, "data", split_axis=0, concat_axis=0, tiled=True
+            )
+
+        def per_shard(lcode, lrow, pcode, prow):
+            # the exchange: every shard sends bucket d of its slice to
+            # shard d and receives all buckets it owns — over ICI, no
+            # materialized shuffle
+            lcode, lrow = a2a(lcode), a2a(lrow)
+            pcode, prow = a2a(pcode), a2a(prow)
+            order = jnp.argsort(lcode)
+            sl = lcode[order]
+            slrow = lrow[order]
+            idx = jnp.searchsorted(sl, pcode)
+            idx_c = jnp.clip(idx, 0, B_l - 1)
+            eq = (sl[idx_c] == pcode) & (pcode >= 0)
+            matched_lrow = jnp.where(eq, slrow[idx_c], -1)
+            outs = [matched_lrow, prow]
+            if want_left_bitmap:
+                hit_sorted = (
+                    jnp.zeros(B_l, dtype=bool).at[idx_c].max(eq)
+                )
+                lmatched = jnp.zeros(B_l, dtype=bool).at[order].set(hit_sorted)
+                outs.extend([lmatched, lrow])
+            return tuple(outs)
+
+        fn = shard_map(
+            per_shard,
+            mesh=mesh,
+            in_specs=(P("data"), P("data"), P("data"), P("data")),
+            out_specs=tuple(
+                P("data") for _ in range(4 if want_left_bitmap else 2)
+            ),
+            check_vma=False,
+        )
+        self._program = jax.jit(fn)
+        self._program_key = key
+        return self._program

@@ -49,6 +49,7 @@ class PhysicalPlanner:
         batch_size: int = 32768,
         coalesce_aggregates: bool = False,
         coalesce_max_bytes: int = 24 << 30,
+        spmd_joins: bool = False,
     ) -> None:
         self.batch_size = batch_size
         # single-chip device execution: plan aggregations SINGLE over merged
@@ -57,6 +58,11 @@ class PhysicalPlanner:
         # readback of its full partial state (config.BALLISTA_TPU_COALESCE_AGG)
         self.coalesce_aggregates = coalesce_aggregates
         self.coalesce_max_bytes = coalesce_max_bytes
+        # SPMD stage fusion on (config.BALLISTA_TPU_SPMD): co-partition
+        # INNER joins too, so the DistributedPlanner can collapse the
+        # exchange pair into one SpmdJoinExec mesh program — broadcast
+        # joins carry no exchange to eliminate and stay per-partition
+        self.spmd_joins = spmd_joins
 
     @staticmethod
     def _leaf_scan_bytes(node: ExecutionPlan) -> int:
@@ -350,7 +356,16 @@ class PhysicalPlanner:
                 )
             )
         partitioned = False
-        if plan.join_type in (lp.JoinType.LEFT, lp.JoinType.FULL):
+        copartition = plan.join_type in (lp.JoinType.LEFT, lp.JoinType.FULL)
+        if (
+            self.spmd_joins
+            and plan.join_type == lp.JoinType.INNER
+            and plan.filter is None
+        ):
+            # SPMD: give inner joins the same co-partitioned shape so the
+            # distributed planner can fuse the exchange into a mesh program
+            copartition = True
+        if copartition:
             nl = left.output_partitioning().partition_count()
             nr = right.output_partitioning().partition_count()
             if nr > 1 or nl > 1:

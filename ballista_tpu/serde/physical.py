@@ -34,6 +34,7 @@ from ballista_tpu.physical.basic import (
     ProjectionExec,
     SortExec,
 )
+from ballista_tpu.parallel.spmd_join import SpmdJoinExec
 from ballista_tpu.parallel.spmd_stage import SpmdAggregateExec
 from ballista_tpu.physical.expr import create_physical_expr
 from ballista_tpu.physical.join import CrossJoinExec, HashJoinExec
@@ -241,6 +242,8 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
         n.unresolved_shuffle.identity = plan.identity
     elif isinstance(plan, SpmdAggregateExec):
         n.spmd_aggregate.subplan.CopyFrom(phys_plan_to_proto(plan.subplan))
+    elif isinstance(plan, SpmdJoinExec):
+        n.spmd_join.subplan.CopyFrom(phys_plan_to_proto(plan.subplan))
     else:
         raise SerdeError(f"cannot serialize physical plan {type(plan).__name__}")
     return n
@@ -268,6 +271,8 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
         return MemoryScanExec(src, projection)
     if which == "spmd_aggregate":
         return SpmdAggregateExec(phys_plan_from_proto(n.spmd_aggregate.subplan))
+    if which == "spmd_join":
+        return SpmdJoinExec(phys_plan_from_proto(n.spmd_join.subplan))
     if which == "projection":
         input = phys_plan_from_proto(n.projection.input)
         schema = input.schema()

@@ -32,28 +32,25 @@ jax.config.update("jax_platforms", "cpu")
 LOCK = pathlib.Path("/tmp/ballista_prepop.lock")
 
 
+_lock_fh = None  # held open for the process lifetime
+
+
 def _acquire_lock() -> bool:
-    """Exclusive-create the lock; a live holder wins, a dead one is replaced."""
-    while True:
-        try:
-            fd = os.open(LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            with os.fdopen(fd, "w") as f:
-                f.write(str(os.getpid()))
-            return True
-        except FileExistsError:
-            try:
-                pid = int(LOCK.read_text().strip() or "0")
-            except (OSError, ValueError):
-                pid = 0
-            if pid > 0:
-                try:
-                    os.kill(pid, 0)
-                    print(f"[prepop] another instance (pid {pid}) is running",
-                          flush=True)
-                    return False
-                except ProcessLookupError:
-                    pass
-            LOCK.unlink(missing_ok=True)  # stale: retry the exclusive create
+    """flock-based mutual exclusion: released automatically on process
+    death, so stale locks cannot exist and there is no check-then-unlink
+    race. relay_watch.sh tests the same lock with `flock -n ... true`."""
+    global _lock_fh
+    import fcntl
+
+    _lock_fh = open(LOCK, "w")
+    try:
+        fcntl.flock(_lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except BlockingIOError:
+        print("[prepop] another instance holds the lock", flush=True)
+        return False
+    _lock_fh.write(str(os.getpid()))
+    _lock_fh.flush()
+    return True
 
 
 def main() -> None:
@@ -78,7 +75,7 @@ def main() -> None:
             except Exception as e:
                 print(f"[prepop] {name} sf={sf}: failed: {e}", flush=True)
     finally:
-        LOCK.unlink(missing_ok=True)
+        _lock_fh.close()  # releases the flock; the file itself stays
 
 
 if __name__ == "__main__":

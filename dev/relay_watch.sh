@@ -10,14 +10,17 @@ mkdir -p benchmarks/results
 while true; do
   if timeout 35 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) relay LIVE — starting capture"
-    while [ -f /tmp/ballista_prepop.lock ]; do
+    # flock held by a live prepopulate process (released on its death; no
+    # staleness handling needed); the pid-content check also covers a
+    # holder started before the flock scheme. Never unlink here.
+    prepop_busy() {
+      [ -f /tmp/ballista_prepop.lock ] || return 1
+      flock -n /tmp/ballista_prepop.lock true || return 0
       pid=$(cat /tmp/ballista_prepop.lock 2>/dev/null)
-      if [ -z "$pid" ] || ! kill -0 "$pid" 2>/dev/null; then
-        echo "stale prepopulation lock (pid ${pid:-?} gone) — proceeding"
-        rm -f /tmp/ballista_prepop.lock
-        break
-      fi
-      echo "waiting for layout prepopulation (pid $pid) to finish"
+      [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null
+    }
+    while prepop_busy; do
+      echo "waiting for layout prepopulation to finish"
       sleep 30
     done
     BENCH_PROBE_BUDGET=60 BENCH_MAX_SECONDS=4800 timeout 7200 \
