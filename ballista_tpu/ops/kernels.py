@@ -133,6 +133,15 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
             # aggregate over a join: try the fact-side pushdown first
             built = FactAggregateStage.try_build(exec_node)
             if built is None:
+                # shapes factagg excludes (multi-key fact joins, dim-valued
+                # aggregate inputs, fact-column group keys — q7-q9/q12):
+                # rewrite the join tree to a mapped fact scan and fuse that
+                from ballista_tpu.ops.mappedscan import try_rewrite_mapped
+
+                rewritten = try_rewrite_mapped(exec_node)
+                if rewritten is not None:
+                    built = FusedAggregateStage(rewritten)
+            if built is None:
                 built = FusedAggregateStage(exec_node)
         except UnsupportedOnDevice:
             built = False

@@ -226,8 +226,12 @@ class FusedAggregateStage:
                 node = node.input
         self.scan = node
         # device columns stay resident only for file-backed scans (stable
-        # data identity); other sources re-execute per query
-        self.cacheable = isinstance(node, _SCAN_TYPES)
+        # data identity); other sources re-execute per query.
+        # ballista_cacheable: composed row sources (ops/mappedscan.py) whose
+        # data identity is still file-backed opt in via the class attribute
+        self.cacheable = isinstance(node, _SCAN_TYPES) or getattr(
+            node, "ballista_cacheable", False
+        )
         scan_schema = node.schema()
 
         # --- re-express every expression against the scan schema --------

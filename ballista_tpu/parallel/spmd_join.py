@@ -117,9 +117,13 @@ class SpmdJoinExec(ExecutionPlan):
             yield from self._execute_host(ctx)
             return
         try:
+            self._inline_host = False
             out = self._execute_mesh(ctx)
-            self.last_path = "mesh"
-            tracing.incr("spmd.join_mesh")
+            self.last_path = "host-inline" if self._inline_host else "mesh"
+            tracing.incr(
+                "spmd.join_host_inline" if self._inline_host
+                else "spmd.join_mesh"
+            )
         except Exception:
             import logging
             import sys
@@ -163,8 +167,6 @@ class SpmdJoinExec(ExecutionPlan):
         # the mesh replaces the hash exchange: read the repartition inputs
         left = collect_all(_strip_repartition(join.left), ctx)
         right = collect_all(_strip_repartition(join.right), ctx)
-        if left.num_rows == 0 or right.num_rows == 0:
-            raise UnsupportedOnDevice("empty join side")
         if max(left.num_rows, right.num_rows) >= (1 << 31):
             raise UnsupportedOnDevice("row ids exceed int32")
 
@@ -175,13 +177,26 @@ class SpmdJoinExec(ExecutionPlan):
         )
         hi = max(int(bcodes.max()), int(pcodes.max())) if len(bcodes) else 0
         if hi >= (1 << 31):
-            # dense re-map: distinct count <= row count < 2^31
+            # dense re-map: distinct count <= row count < 2^31. _refactorize
+            # assigns the -1 null sentinel a dense code too — restore it, or
+            # null keys would match each other on the mesh
+            bnull, pnull = bcodes < 0, pcodes < 0
             bcodes, pcodes, _ = _refactorize(bcodes, pcodes)
-        # searchsorted yields one match per probe: build keys must be unique
+            bcodes = np.where(bnull, -1, bcodes)
+            pcodes = np.where(pnull, -1, pcodes)
+        # searchsorted yields one match per probe: duplicate build keys
+        # (many-many multiplicity) and empty sides skip the mesh — but the
+        # sides are already collected and coded, so join INLINE on the host
+        # (vectorized join_indices) instead of re-executing the subplan with
+        # its materialized shuffles
         valid_b = bcodes >= 0
         uniq = np.unique(bcodes[valid_b])
-        if len(uniq) != int(valid_b.sum()):
-            raise UnsupportedOnDevice("duplicate build keys (many-many join)")
+        if (
+            len(uniq) != int(valid_b.sum())
+            or left.num_rows == 0
+            or right.num_rows == 0
+        ):
+            return self._host_join_collected(left, right, bcodes, pcodes)
 
         # ---- host staging: bucket (code, rowid) by key ownership ------
         def stage_side(codes: np.ndarray):
@@ -244,6 +259,26 @@ class SpmdJoinExec(ExecutionPlan):
                 right_out = pa.concat_tables([right_out, nulls])
         cols = list(left_out.columns) + list(right_out.columns)
         return pa.table(cols, schema=self.schema())
+
+    def _host_join_collected(
+        self, left: pa.Table, right: pa.Table,
+        bcodes: np.ndarray, pcodes: np.ndarray,
+    ) -> pa.Table:
+        """Vectorized host join over the already-collected sides — the
+        decline path for shapes the mesh program cannot take (duplicate
+        build keys, empty sides). Costs one collect + one join pass, like
+        the broadcast join these plans had before SPMD co-partitioning; no
+        shuffle materialization, no re-execution."""
+        from ballista_tpu.physical.joinutil import join_indices, take_table
+
+        self._inline_host = True
+        how = "inner" if self.subplan.join_type == JoinType.INNER else "left"
+        li, ri = join_indices(bcodes, pcodes, how)
+        lt = take_table(left, li)
+        rt = take_table(right, ri)
+        return pa.table(
+            list(lt.columns) + list(rt.columns), schema=self.schema()
+        )
 
     # ------------------------------------------------------------------
     def _get_program(self, mesh, n_dev: int, B_l: int, B_p: int,

@@ -38,8 +38,11 @@ BATCH = "16777216"
 # aggregate-over-join shape); SF=10 and SF=100 cover the "beyond SF=1"
 # requirement with the cached oracle-verified datasets.
 CONFIGS = [(1.0, "q1"), (1.0, "q6"), (1.0, "q3"), (1.0, "q5"), (1.0, "q10"),
+           (1.0, "q7"), (1.0, "q12"),
            (10.0, "q1"), (10.0, "q6"), (10.0, "q3"), (10.0, "q5"),
-           (100.0, "q1"), (100.0, "q6"), (100.0, "q3"), (100.0, "q5")]
+           (10.0, "q7"), (10.0, "q12"),
+           (100.0, "q1"), (100.0, "q6"), (100.0, "q3"), (100.0, "q5"),
+           (100.0, "q12")]
 # SF>=this only runs when the dataset is already on disk: generating SF=100
 # (~16GB parquet, hours on one core) must never eat the capture window
 _NO_GEN_ABOVE_SF = float(os.environ.get("BENCH_NO_GEN_ABOVE_SF", "10"))

@@ -42,12 +42,26 @@ def _acquire_lock() -> bool:
     global _lock_fh
     import fcntl
 
-    _lock_fh = open(LOCK, "w")
+    # "a" not "w": must not truncate a pre-flock-scheme holder's pid record
+    # before knowing the lock is ours
+    _lock_fh = open(LOCK, "a")
     try:
         fcntl.flock(_lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
     except BlockingIOError:
         print("[prepop] another instance holds the lock", flush=True)
         return False
+    # legacy holder (no flock, pid content): defer if it is still alive
+    try:
+        pid = int(LOCK.read_text().strip() or "0")
+        if pid > 0 and pid != os.getpid():
+            os.kill(pid, 0)
+            print(f"[prepop] legacy instance (pid {pid}) is running",
+                  flush=True)
+            return False
+    except (ValueError, ProcessLookupError, OSError):
+        pass
+    _lock_fh.seek(0)
+    _lock_fh.truncate()
     _lock_fh.write(str(os.getpid()))
     _lock_fh.flush()
     return True

@@ -173,7 +173,9 @@ def test_duplicate_build_keys_fall_back_to_host():
     assert spmd is not None
     tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
     out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
-    assert spmd.last_path == "host"
+    # declines join INLINE over the already-collected sides (no subplan
+    # re-execution, no shuffle materialization)
+    assert spmd.last_path == "host-inline"
     oracle = _host_oracle(left, right, ["dk"], ["fk"], "inner")
     assert _canon(out, ["dk", "amount"]) == _canon(oracle, ["dk", "amount"])
 
@@ -208,3 +210,42 @@ def test_cpu_backend_uses_host_path():
     out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
     oracle = _host_oracle(dim, fact, ["dk"], ["fk"], "inner")
     assert _canon(out, ["dk", "amount"]) == _canon(oracle, ["dk", "amount"])
+
+
+def test_refactorize_preserves_null_sentinel():
+    """Composite keys whose packed cardinality exceeds 2^31 go through the
+    dense re-map; null keys must stay -1 (never match) afterwards."""
+    n = 60_000
+    left = pa.table(
+        {
+            "a": pa.array(
+                [None] + list(range(1, n)), type=pa.int64()
+            ),  # one null build key
+            "b": pa.array(np.arange(n) * 7 % (n + 13), type=pa.int64()),
+            "lv": pa.array(np.arange(n, dtype=np.int64)),
+        }
+    )
+    right = pa.table(
+        {
+            "x": pa.array([None, 5, 10, None, 999999], type=pa.int64()),
+            "y": pa.array(
+                [int(left.column("b")[1].as_py()), 35 % (n + 13),
+                 70 % (n + 13), 3, 4],
+                type=pa.int64(),
+            ),
+            "rv": pa.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+        }
+    )
+    spmd, cfg = _plan_join(left, right, ["a", "b"], ["x", "y"], "left",
+                           nl=2, nr=2)
+    assert spmd is not None
+    tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+    out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
+    assert spmd.last_path == "mesh"
+    oracle = _host_oracle(left, right, ["a", "b"], ["x", "y"], "left")
+    assert out.num_rows == oracle.num_rows
+    # the null-key left row appears exactly once, unmatched
+    null_rows = [i for i, v in enumerate(out.column("a").to_pylist())
+                 if v is None]
+    assert len(null_rows) == 1
+    assert out.column("rv")[null_rows[0]].as_py() is None
