@@ -395,4 +395,13 @@ def try_rewrite_mapped(agg) -> Optional[object]:
         return None
     if getattr(agg, "_topk_pushdown", None) is not None:
         out._topk_pushdown = agg._topk_pushdown
+    # the framework drives the ORIGINAL aggregate's partition count (the
+    # join's probe side); the rewritten stage scans the FACT's partitions.
+    # When they differ, the stage must stripe fact partitions over the
+    # driven ones or it would silently aggregate a fraction of the fact
+    # (same hazard factagg guards at ops/factagg.py:343-347)
+    n_driven = agg.input.output_partitioning().partition_count()
+    n_fact = mapped.output_partitioning().partition_count()
+    if n_driven != n_fact:
+        out._scan_stride_hint = n_driven
     return out

@@ -224,6 +224,12 @@ class FusedAggregateStage:
                 if isinstance(node, MergeExec):
                     self.scan_stride = 1
                 node = node.input
+        if self.scan_stride is None:
+            # a rewritten aggregate (ops/mappedscan.py) whose driven
+            # partition count differs from its scan's: stripe the scan
+            hint = getattr(agg, "_scan_stride_hint", None)
+            if hint is not None:
+                self.scan_stride = int(hint)
         self.scan = node
         # device columns stay resident only for file-backed scans (stable
         # data identity); other sources re-execute per query.

@@ -1,0 +1,115 @@
+"""Multi-host mesh utilities: the host-boundary decomposition of an SPMD
+stage (SURVEY §2.8: partitions -> shards of a pod mesh).
+
+Contract (the "multi-host story" spmd_stage.py's per-shard decomposition is
+written against):
+
+  - input partition p belongs to mesh shard ``p % n_shards``; a host reads
+    ONLY partitions whose shard lives on one of its local devices (batches
+    may balance freely among a host's OWN shards — that stays host-local).
+  - shards exchange only their DISTINCT group keys; every host ranks the
+    gathered union identically (same input, same deterministic sort), so
+    global group ids agree with no central coordinator.
+  - any decline (unsupported shape, overflow risk) must be COLLECTIVE:
+    hosts agree with an all-reduce before diverging onto the host path,
+    or one host would enter the mesh program alone and hang the pod.
+
+Process topology comes from ``jax.distributed.initialize`` (the reference
+reaches multi-host scale with one executor process per node and NCCL/MPI
+underneath; here the same SPMD program spans hosts and XLA's collectives
+ride ICI/DCN — Gloo on the CPU test backend)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+def local_shard_ids(mesh) -> List[int]:
+    """Flat mesh-shard indices owned by THIS process."""
+    import jax
+
+    pid = jax.process_index()
+    return [
+        i for i, d in enumerate(mesh.devices.flat) if d.process_index == pid
+    ]
+
+
+def partition_shard(p: int, n_shards: int) -> int:
+    """The host-boundary read-ownership rule: partition -> shard."""
+    return p % n_shards
+
+
+def owned_partitions(n_parts: int, mesh) -> List[int]:
+    """Partitions THIS process must read (its shards' partitions)."""
+    n_shards = int(np.prod(list(mesh.shape.values())))
+    mine = set(local_shard_ids(mesh))
+    return [p for p in range(n_parts) if partition_shard(p, n_shards) in mine]
+
+
+def allgather_rows(x: np.ndarray) -> np.ndarray:
+    """Gather variable-length per-process 1-D arrays; returns the
+    concatenation (identical on every process). Lengths are exchanged
+    first, then data padded to the max."""
+    import jax
+    from jax.experimental import multihost_utils as mhu
+
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    x = np.asarray(x)
+    lens = mhu.process_allgather(np.array([len(x)], dtype=np.int64))
+    lens = np.asarray(lens).reshape(-1)
+    pad = int(lens.max()) if len(lens) else 0
+    padded = np.zeros(pad, dtype=x.dtype if x.dtype != np.bool_ else np.int64)
+    padded[: len(x)] = x
+    gathered = np.asarray(mhu.process_allgather(padded))
+    return np.concatenate(
+        [gathered[i, : int(lens[i])] for i in range(len(lens))]
+    ) if pad else np.zeros(0, dtype=x.dtype)
+
+
+def agree(ok: bool) -> bool:
+    """Collective AND across processes — declines must be unanimous."""
+    import jax
+    from jax.experimental import multihost_utils as mhu
+
+    if jax.process_count() == 1:
+        return ok
+    flags = np.asarray(
+        mhu.process_allgather(np.array([1 if ok else 0], dtype=np.int64))
+    )
+    return bool(flags.min() == 1)
+
+
+def global_max(v: int) -> int:
+    import jax
+    from jax.experimental import multihost_utils as mhu
+
+    if jax.process_count() == 1:
+        return int(v)
+    vals = np.asarray(
+        mhu.process_allgather(np.array([int(v)], dtype=np.int64))
+    )
+    return int(vals.max())
+
+
+def make_sharded(mesh, blocks: dict, total_len: int, dtype) -> object:
+    """Assemble a globally-sharded 1-D array from this process's per-shard
+    blocks. blocks: flat shard id -> np.ndarray of length total_len // n.
+    Every shard id this process owns must be present."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = int(np.prod(list(mesh.shape.values())))
+    block = total_len // n
+    sharding = NamedSharding(mesh, P(tuple(mesh.shape.keys())[0]))
+    devs = list(mesh.devices.flat)
+    arrays = []
+    for i in local_shard_ids(mesh):
+        b = blocks[i]
+        assert len(b) == block, (len(b), block)
+        arrays.append(jax.device_put(b.astype(dtype, copy=False), devs[i]))
+    return jax.make_array_from_single_device_arrays(
+        (total_len,), sharding, arrays
+    )

@@ -175,7 +175,10 @@ class SpmdJoinExec(ExecutionPlan):
         bcodes, pcodes = combined_key_codes(
             [left.column(k) for k in lkeys], [right.column(k) for k in rkeys]
         )
-        hi = max(int(bcodes.max()), int(pcodes.max())) if len(bcodes) else 0
+        if left.num_rows == 0 or right.num_rows == 0:
+            # no mesh work to do; join inline over what was collected
+            return self._host_join_collected(left, right, bcodes, pcodes)
+        hi = max(int(bcodes.max()), int(pcodes.max()))
         if hi >= (1 << 31):
             # dense re-map: distinct count <= row count < 2^31. _refactorize
             # assigns the -1 null sentinel a dense code too — restore it, or
@@ -191,11 +194,7 @@ class SpmdJoinExec(ExecutionPlan):
         # its materialized shuffles
         valid_b = bcodes >= 0
         uniq = np.unique(bcodes[valid_b])
-        if (
-            len(uniq) != int(valid_b.sum())
-            or left.num_rows == 0
-            or right.num_rows == 0
-        ):
+        if len(uniq) != int(valid_b.sum()):
             return self._host_join_collected(left, right, bcodes, pcodes)
 
         # ---- host staging: bucket (code, rowid) by key ownership ------

@@ -84,6 +84,61 @@ def _rank_rows(columns):
     return inv.astype(np.int32), uniq_rows, n_uniq
 
 
+def _key_as_i64(a) -> np.ndarray:
+    """Key column -> int64 numpy for the multi-host union allgather."""
+    from ballista_tpu.ops.runtime import UnsupportedOnDevice
+
+    if isinstance(a, pa.ChunkedArray):
+        a = a.combine_chunks()
+    if not isinstance(a, pa.Array):
+        a = pa.array(a)
+    t = a.type
+    if pa.types.is_date32(t):
+        a = a.cast(pa.int32())
+    elif pa.types.is_boolean(t):
+        a = a.cast(pa.int8())
+    elif not pa.types.is_integer(t):
+        raise UnsupportedOnDevice(
+            "multi-host key union requires integer-like keys"
+        )
+    return a.cast(pa.int64()).to_numpy(zero_copy_only=False).astype(np.int64)
+
+
+def _rebuild_key_arrays(stage, gathered: List[np.ndarray],
+                        first_idx: np.ndarray, n_keys: int) -> List[pa.Array]:
+    """Group key values in rank order, cast from the int64 wire form back
+    to each key expression's Arrow type."""
+    gkv = []
+    for j in range(n_keys):
+        target = stage.group_exprs[j][0].data_type(stage.scan_schema)
+        vals = gathered[j][first_idx]
+        arr = pa.array(vals)
+        if arr.type != target:
+            if pa.types.is_date32(target):
+                arr = arr.cast(pa.int32()).cast(target)
+            elif pa.types.is_boolean(target):
+                arr = arr.cast(pa.int8()).cast(target)
+            else:
+                arr = arr.cast(target)
+        gkv.append(arr)
+    return gkv
+
+
+def _np_dtype_for(dtype: pa.DataType) -> np.dtype:
+    """The numpy dtype column_to_numpy produces for an Arrow type —
+    derived by lowering a ZERO-LENGTH column through column_to_numpy
+    itself, so there is one source of truth: an empty host's blocks always
+    dtype-match its data-bearing peers' (one shared jit program)."""
+    from ballista_tpu.ops.runtime import ColumnDictionary, column_to_numpy
+
+    d = (
+        ColumnDictionary()
+        if pa.types.is_string(dtype) or pa.types.is_large_string(dtype)
+        else None
+    )
+    return column_to_numpy(pa.array([], type=dtype), dtype, d).dtype
+
+
 class SpmdAggregateExec(ExecutionPlan):
     """Executes Final(Repartition(Partial(input))) as one mesh program.
 
@@ -207,6 +262,7 @@ class SpmdAggregateExec(ExecutionPlan):
 
     # ------------------------------------------------------------------
     def _execute_mesh(self, ctx: TaskContext) -> pa.Table:
+        import jax
         import jax.numpy as jnp
 
         from ballista_tpu.ops.runtime import UnsupportedOnDevice, bucket_rows
@@ -217,6 +273,10 @@ class SpmdAggregateExec(ExecutionPlan):
         stage = self._stage
         mesh = self._build_mesh(ctx)
         n_dev = int(np.prod(list(mesh.shape.values())))
+        if jax.process_count() > 1:
+            # pod path: per-host shard reads, collective key exchange, the
+            # SAME shard_map program over the global mesh
+            return self._execute_mesh_multihost(ctx, stage, mesh, n_dev)
 
         # ---- 1. per-shard reads: each shard scans and group-codes ONLY its
         # own rows. Batches go to the least-loaded shard (batches are finer
@@ -290,6 +350,168 @@ class SpmdAggregateExec(ExecutionPlan):
                 mesh, stage, shards, n_groups, n_dev, aux
             )
         partial_table = stage._assemble_partial(outputs, counts, gkv, n_groups)
+        return self.final._final(partial_table)
+
+    def _execute_mesh_multihost(self, ctx, stage, mesh, n_dev) -> pa.Table:
+        """Multi-process mesh execution (jax.distributed): this process
+        reads ONLY the partitions its local shards own (multihost.py's
+        host-boundary contract), every host ranks the allgathered
+        distinct-key union identically, local shard blocks assemble into
+        globally-sharded arrays, and the SAME jitted shard_map program the
+        single-host path uses runs over the pod mesh. Every decline is
+        collective (multihost.agree): a unilateral fallback would leave
+        the other hosts blocked inside the program's collectives.
+
+        v1 scope (collectively enforced): integer/date/bool group keys
+        (the key union rides an int64 allgather), no string columns
+        anywhere in the stage (per-host dictionary growth would diverge
+        the aux shapes), G <= MAX_GROUPS (the unrolled program). The
+        reference reaches multi-node scale with one executor process per
+        node over NCCL/MPI; this is the mesh-native equivalent."""
+        import jax
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.runtime import UnsupportedOnDevice, bucket_rows
+        from ballista_tpu.ops.stage import MAX_GROUPS, dense_rank
+        from ballista_tpu.parallel import multihost as mh
+
+        # ---- per-host reads: only partitions owned by local shards ----
+        parts = stage.scan.output_partitioning().partition_count()
+        my_shards = mh.local_shard_ids(mesh)
+        shard_batches = {i: [] for i in my_shards}
+        shard_rows = {i: 0 for i in my_shards}
+        n_keys = len(stage.group_exprs)
+        local: Dict[int, dict] = {}
+        ok = True
+        my_distinct: List[np.ndarray] = [
+            np.zeros(0, dtype=np.int64) for _ in range(n_keys)
+        ]
+        try:
+            if any(
+                pa.types.is_string(t) or pa.types.is_large_string(t)
+                for t in stage.compiler.used_columns.values()
+            ):
+                raise UnsupportedOnDevice(
+                    "multi-host v1: string columns diverge per-host dictionaries"
+                )
+            for p in mh.owned_partitions(parts, mesh):
+                for b in stage._scan_batches(p, ctx):
+                    if not b.num_rows:
+                        continue
+                    # balance batches among THIS host's own shards only
+                    si = min(shard_rows, key=shard_rows.get)
+                    shard_batches[si].append(b)
+                    shard_rows[si] += b.num_rows
+            for si, bs in shard_batches.items():
+                if not bs:
+                    continue
+                t = pa.Table.from_batches(bs).combine_chunks()
+                batch = t.to_batches(max_chunksize=t.num_rows)[0]
+                codes, kv, g = stage._group_codes(batch)
+                local[si] = {"batch": batch, "codes": codes, "kv": kv, "g": g}
+            # this host's distinct key tuples as parallel int64 columns
+            # (shards in local-iteration order; rows stay tuple-aligned)
+            cols_j: List[List[np.ndarray]] = [[] for _ in range(n_keys)]
+            for d in local.values():
+                for j in range(n_keys):
+                    cols_j[j].append(_key_as_i64(d["kv"][j]))
+            for j in range(n_keys):
+                if cols_j[j]:
+                    my_distinct[j] = np.concatenate(cols_j[j])
+            for d in local.values():
+                d["npcols"] = stage._lower_columns(d["batch"])
+        except UnsupportedOnDevice:
+            ok = False
+        if not mh.agree(ok):
+            raise UnsupportedOnDevice("multi-host mesh declined collectively")
+
+        my_rows = sum(d["batch"].num_rows for d in local.values())
+        all_rows = mh.allgather_rows(np.array([my_rows], dtype=np.int64))
+        if int(all_rows.sum()) == 0:
+            return self.schema().empty_table()
+
+        # ---- collective key union; identical ranking on every host ----
+        if n_keys == 0:
+            n_groups, gkv = 1, []
+            for d in local.values():
+                d["gcodes"] = d["codes"]
+        else:
+            gathered = [mh.allgather_rows(c) for c in my_distinct]
+            encoded = []
+            for col in gathered:
+                uniq, inv = np.unique(col, return_inverse=True)
+                encoded.append((inv.astype(np.int64), len(uniq)))
+            inv_all, first_idx, n_groups = dense_rank(encoded)
+            # this host's slice of the gathered ranking
+            my_count = sum(d["g"] for d in local.values())
+            counts = mh.allgather_rows(
+                np.array([my_count], dtype=np.int64)
+            )
+            pos = int(counts[: jax.process_index()].sum())
+            for d in local.values():
+                mapping = inv_all[pos: pos + d["g"]]
+                pos += d["g"]
+                d["gcodes"] = mapping[d["codes"]].astype(np.int32)
+            gkv = _rebuild_key_arrays(stage, gathered, first_idx, n_keys)
+
+        ok = n_groups <= MAX_GROUPS
+        if not mh.agree(ok):
+            raise UnsupportedOnDevice(
+                "multi-host sorted path not yet supported (G > MAX_GROUPS)"
+            )
+
+        # ---- int-overflow check over the GLOBAL row count --------------
+        ok = True
+        try:
+            stage._check_int_ranges(
+                [d["npcols"] for d in local.values()],
+                max(int(all_rows.sum()), 1),
+            )
+        except UnsupportedOnDevice:
+            ok = False
+        if not mh.agree(ok):
+            raise UnsupportedOnDevice("multi-host int-range decline")
+
+        # ---- assemble globally-sharded blocks; run the SAME program ----
+        local_max = max(
+            [d["batch"].num_rows for d in local.values()], default=1
+        )
+        S = mh.global_max(int(bucket_rows(local_max)))
+        col_ids = sorted(stage.compiler.used_columns)
+        aux = [jnp.asarray(a) for a in stage.compiler.build_aux()]
+        cols: Dict[int, object] = {}
+        for idx in col_ids:
+            np_dtype = _np_dtype_for(stage.compiler.used_columns[idx])
+            blocks = {}
+            for si in my_shards:
+                big = np.zeros(S, dtype=np_dtype)
+                d = local.get(si)
+                if d is not None:
+                    npcol = d["npcols"][idx].astype(np_dtype, copy=False)
+                    big[: len(npcol)] = npcol
+                blocks[si] = big
+            cols[idx] = mh.make_sharded(mesh, blocks, S * n_dev, np_dtype)
+        codes_blocks, valid_blocks = {}, {}
+        for si in my_shards:
+            cb = np.zeros(S, dtype=np.int32)
+            vb = np.zeros(S, dtype=np.bool_)
+            d = local.get(si)
+            if d is not None:
+                n = d["batch"].num_rows
+                cb[:n] = d["gcodes"]
+                vb[:n] = True
+            codes_blocks[si] = cb
+            valid_blocks[si] = vb
+        codes_g = mh.make_sharded(mesh, codes_blocks, S * n_dev, np.int32)
+        valid_g = mh.make_sharded(mesh, valid_blocks, S * n_dev, np.bool_)
+
+        seg = int(bucket_rows(n_groups, 16)) + 1
+        program = self._get_program(mesh, stage, seg, set(cols.keys()), len(aux))
+        stacked = np.asarray(program(cols, aux, codes_g, valid_g))
+        rows = stage._decode_stacked(stacked)
+        counts_np = rows[0][:n_groups]
+        outputs = [r[:n_groups] for r in rows[1:]]
+        partial_table = stage._assemble_partial(outputs, counts_np, gkv, n_groups)
         return self.final._final(partial_table)
 
     def _run_unrolled_mesh(self, mesh, stage, shards, n_groups, n_dev, aux):

@@ -89,6 +89,13 @@ def main() -> None:
             except Exception as e:
                 print(f"[prepop] {name} sf={sf}: failed: {e}", flush=True)
     finally:
+        # truncate before release: a later run must not mistake OUR stale
+        # pid (possibly recycled) for a live legacy holder
+        try:
+            _lock_fh.seek(0)
+            _lock_fh.truncate()
+        except OSError:
+            pass
         _lock_fh.close()  # releases the flock; the file itself stays
 
 
