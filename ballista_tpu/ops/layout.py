@@ -88,7 +88,17 @@ class SortedSegmentLayout:
         )
 
         V = len(owner)
-        idx = cstart[:, None] + np.arange(L1, dtype=np.int64)[None, :]
+        # int32 index math: at SF=100 these transients are the prepare's
+        # host-memory peak (600M rows: int64 idx alone was 9.6 GB; the
+        # whole prepare OOM-killed a 125 GB host before this). Oversized
+        # partitions must DECLINE to the host path, not wrap indices.
+        if len(codes) >= (1 << 31):
+            from ballista_tpu.ops.runtime import UnsupportedOnDevice
+
+            raise UnsupportedOnDevice(
+                f"partition of {len(codes)} rows exceeds int32 row indexing"
+            )
+        idx = cstart.astype(np.int32)[:, None] + np.arange(L1, dtype=np.int32)[None, :]
         pad = np.arange(L1, dtype=np.int64)[None, :] < clen[:, None]
         idx = np.where(pad, idx, 0)
 
@@ -96,7 +106,8 @@ class SortedSegmentLayout:
         self.L1 = L1
         self.V = V
         # take-index into ORIGINAL row positions
-        self.row_take = order[idx.reshape(-1)].reshape(V, L1)
+        self.row_take = order.astype(np.int32)[idx.reshape(-1)].reshape(V, L1)
+        del idx
         self.pad = pad  # bool [V, L1]
         self.owner = owner  # sorted [V]
         # fold_*'s reduceat bookkeeping assumes every group owns >=1 chunk;

@@ -731,22 +731,35 @@ class FusedAggregateStage:
         layout = SortedSegmentLayout(
             codes, n_groups, cover_max=getattr(self, "sorted_cover_max", False)
         )
+        del codes
         npcols = self._lower_columns(batch)
         self._check_int_ranges(npcols, layout.L1)
+        # derived columns read row-space npcols; compute BEFORE the staging
+        # loop below starts freeing them
+        derived_raw = {name: fn(npcols) for name, fn in self.derive_columns.items()}
+        # the Arrow buffers are no longer needed: at SF=100 the combined
+        # table is ~25 GB that would otherwise sit under the whole
+        # materialization peak (this prepare OOM-killed a 125 GB host)
+        del batches, table, batch
         # stage narrow tiles HOST-side and check the HBM budget BEFORE any
         # device allocation: the planner's coalesce cap compares compressed
         # leaf bytes, which under-counts columns that fail to narrow — a
-        # too-big stage must fall to the host path, not OOM the chip
+        # too-big stage must fall to the host path, not OOM the chip.
+        # Row-space columns free as their tiles materialize: the peak holds
+        # one column in row space, not every used column at once.
         staged: Dict[int, tuple] = {}
         total = layout.pad.nbytes
-        for idx, npcol in npcols.items():
+        for idx in list(npcols):
+            npcol = npcols.pop(idx)
             narrow, lut, choice = narrow_column(npcol, self._narrow_choice.get(idx))
+            del npcol
             tiles = layout.materialize(narrow)
+            del narrow
             staged[idx] = (tiles, lut, choice)
             total += tiles.nbytes + (lut.nbytes if lut is not None else 0)
         staged_derived: Dict[str, tuple] = {}
-        for name, fn in self.derive_columns.items():
-            raw = fn(npcols)
+        for name in list(derived_raw):
+            raw = derived_raw.pop(name)
             if raw.dtype == np.int32:
                 # int-only narrowing: derived tiles travel as standalone
                 # step arguments (not through widen_cols), so the consumer
@@ -757,7 +770,11 @@ class FusedAggregateStage:
                 staged_derived[name] = (tiles, key, choice)
             else:
                 staged_derived[name] = (layout.materialize(raw), None, None)
+            del raw
             total += staged_derived[name][0].nbytes
+        # the take-index served every materialize; drop it before the h2d
+        # staging peak (persisted entries never carry it)
+        layout.row_take = None
         budget = ctx.config.tpu_hbm_budget()
         if total > budget:
             # checked BEFORE persisting so an undeployable layout is never

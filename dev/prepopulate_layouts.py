@@ -3,13 +3,17 @@
 Runs each bench config's device-backend side ONCE on CPU jax (the host
 prepare — decode, encode, rank, sort, materialize, narrow — is identical on
 any jax platform, and the persisted artifact is host-side numpy), so a later
-relay-attached bench run skips straight to the h2d transfer. Holds
-/tmp/ballista_prepop.lock while running; dev/relay_watch.sh waits on it so a
-live-relay capture never shares the machine with this scan-heavy job.
+relay-attached bench run skips straight to the h2d transfer. Each config
+runs in its OWN subprocess: a SF=100 prepare's host peak is tens of GB and
+earlier configs' pinned residency must not stack under it (the in-process
+loop OOM-killed a 125 GB host). Holds a flock on /tmp/ballista_prepop.lock
+while running; dev/relay_watch.sh waits on it so a live-relay capture never
+shares the machine with this scan-heavy job.
 
-Usage: run from the repo root with the relay-free CPU env:
+Usage (from the repo root, relay-free CPU env):
   env -u PALLAS_AXON_POOL_IPS -u PALLAS_AXON_REMOTE_COMPILE \
-      JAX_PLATFORMS=cpu python dev/prepopulate_layouts.py
+      JAX_PLATFORMS=cpu python dev/prepopulate_layouts.py            # all
+  ... python dev/prepopulate_layouts.py q5 100.0                     # one
 """
 
 import os
@@ -30,8 +34,6 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 LOCK = pathlib.Path("/tmp/ballista_prepop.lock")
-
-
 _lock_fh = None  # held open for the process lifetime
 
 
@@ -67,37 +69,58 @@ def _acquire_lock() -> bool:
     return True
 
 
+def _release_lock() -> None:
+    # truncate before release: a later run must not mistake OUR stale
+    # pid (possibly recycled) for a live legacy holder
+    try:
+        _lock_fh.seek(0)
+        _lock_fh.truncate()
+    except OSError:
+        pass
+    _lock_fh.close()  # releases the flock; the file itself stays
+
+
+def run_one(name: str, sf: float) -> None:
+    """Prepare one config in THIS process (child mode)."""
+    import bench
+
+    sql = (bench.QUERIES_DIR / f"{name}.sql").read_text()
+    bench.run_once("tpu", sql, sf)
+
+
 def main() -> None:
     if not _acquire_lock():
         return
     try:
+        import subprocess
+
         import bench
+        from benchmarks.tpch.datagen import is_complete
 
         for sf, name in bench.CONFIGS:
             try:
-                from benchmarks.tpch.datagen import is_complete
-
                 if not is_complete(str(bench.data_dir(sf))):
                     print(f"[prepop] {name} sf={sf}: dataset absent, skipped",
                           flush=True)
                     continue
-                sql = (bench.QUERIES_DIR / f"{name}.sql").read_text()
                 t0 = time.monotonic()
-                bench.run_once("tpu", sql, sf)
-                print(f"[prepop] {name} sf={sf}: {time.monotonic()-t0:.1f}s",
-                      flush=True)
+                # child stdout/stderr stream to ours: progress stays live
+                r = subprocess.run(
+                    [sys.executable, str(REPO / "dev" /
+                                         "prepopulate_layouts.py"),
+                     name, str(sf)],
+                )
+                status = "ok" if r.returncode == 0 else f"rc={r.returncode}"
+                print(f"[prepop] {name} sf={sf}: {status} "
+                      f"{time.monotonic()-t0:.1f}s", flush=True)
             except Exception as e:
                 print(f"[prepop] {name} sf={sf}: failed: {e}", flush=True)
     finally:
-        # truncate before release: a later run must not mistake OUR stale
-        # pid (possibly recycled) for a live legacy holder
-        try:
-            _lock_fh.seek(0)
-            _lock_fh.truncate()
-        except OSError:
-            pass
-        _lock_fh.close()  # releases the flock; the file itself stays
+        _release_lock()
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3:
+        run_one(sys.argv[1], float(sys.argv[2]))  # child: no lock, one config
+    else:
+        main()
