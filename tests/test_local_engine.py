@@ -239,3 +239,27 @@ def test_full_join_multi_partition():
     assert sorted(k for k in ks if k is not None) == [1, 2, 3, 5, 7]
     ws = out.column("w").to_pylist()
     assert sorted(w for w in ws if w is not None) == [20, 30, 40, 60]
+
+
+def test_outer_join_expression_keys_rejected_at_planning():
+    """r3 Weak #7: outer joins whose inputs cannot be hash-co-partitioned
+    (expression keys, residual conditions) never reach execution — the SQL
+    front end rejects them with a clear error, so the PlanError fallback in
+    physical/join.py is purely defensive."""
+    import pyarrow as pa
+    import pytest as _pytest
+
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.errors import SqlError
+
+    c = ExecutionContext()
+    c.register_record_batches(
+        "l", pa.table({"a": [1, 2], "x": [1.0, 2.0]}), n_partitions=2
+    )
+    c.register_record_batches(
+        "r", pa.table({"b": [2, 3], "y": [9.0, 8.0]}), n_partitions=2
+    )
+    with _pytest.raises(SqlError, match="unsupported ON condition"):
+        c.sql("select * from l left join r on a + 1 = b").collect()
+    with _pytest.raises(SqlError, match="unsupported ON condition"):
+        c.sql("select * from l full join r on a = b and x > y").collect()
