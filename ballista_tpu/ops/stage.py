@@ -177,6 +177,40 @@ def substitute_columns(e: px.PhysicalExpr, mapping: List[px.PhysicalExpr]) -> px
     raise UnsupportedOnDevice(f"cannot inline {type(e).__name__}")
 
 
+def _pack_staged(staged: Dict, arrays: List[np.ndarray]) -> Dict[str, dict]:
+    """Append a staged {idx: (tiles, lut, choice)} dict's arrays to the
+    persistence list, returning the JSON column manifest. Shared by the
+    sorted and batches save paths."""
+    cols_meta: Dict[str, dict] = {}
+    for idx, (tiles, lut, choice) in staged.items():
+        spec = {"tiles": len(arrays), "choice": choice, "lut": None}
+        arrays.append(tiles)
+        if lut is not None:
+            spec["lut"] = len(arrays)
+            arrays.append(lut)
+        cols_meta[str(idx)] = spec
+    return cols_meta
+
+
+def _unpack_staged(cols_meta: Dict[str, dict], arrays: List[np.ndarray],
+                   narrow_choice: Dict) -> Optional[Tuple[Dict, int]]:
+    """Inverse of _pack_staged: (staged dict, byte total), or None when a
+    persisted narrow choice conflicts with one the jitted step already
+    compiled against."""
+    staged: Dict[int, tuple] = {}
+    total = 0
+    for k, spec in cols_meta.items():
+        idx = int(k)
+        tiles = arrays[spec["tiles"]]
+        lut = arrays[spec["lut"]] if spec["lut"] is not None else None
+        cur = narrow_choice.get(idx)
+        if cur is not None and cur != spec["choice"]:
+            return None
+        staged[idx] = (tiles, lut, spec["choice"])
+        total += tiles.nbytes + (0 if lut is None else lut.nbytes)
+    return staged, total
+
+
 def _upload_staged(staged: Dict, choices: Dict) -> Dict:
     """Transfer staged (array, lut, choice) columns, recording the narrow
     choice per key and freeing each host tile right after its device copy
@@ -634,9 +668,23 @@ class FusedAggregateStage:
 
     def _prepare_partition(self, partition: int, ctx) -> List[dict]:
         """Host work for one partition: scan, encode, pad, transfer. Returns
-        per-batch device-input entries (jnp column arrays stay resident)."""
+        per-batch device-input entries (jnp column arrays stay resident).
+        Like the sorted path, the staged host artifacts persist through
+        ops/layout_cache.py: the low-cardinality shapes (q1/q6) pay the
+        same full-scan decode at SF=100 (~400 s measured), so a fresh
+        process must skip straight to the h2d transfer too. Uploads stay
+        IN-LOOP: each batch's narrow choice must feed the next batch's
+        narrow_column prior (one jitted step), and the non-persisting host
+        peak stays one batch's tiles. When persisting, a host snapshot of
+        every batch's tiles is retained until the save at the end — up to
+        the HBM budget of extra host RSS, for that one prepare."""
         import jax.numpy as jnp
 
+        persisting = (
+            bool(ctx.config.tpu_layout_cache_dir())
+            and self.persist_key is not None
+        )
+        records: List[dict] = []
         entries: List[dict] = []
         # all of a partition's batch entries are live on device at once
         # during run(); past the budget, decline to the host path rather
@@ -660,11 +708,13 @@ class FusedAggregateStage:
             npcols = self._lower_columns(batch)
             self._check_int_ranges(npcols, n)
             staged: Dict[int, tuple] = {}
-            for idx, npcol in npcols.items():
+            for idx in list(npcols):
+                npcol = npcols.pop(idx)
                 fill = False if npcol.dtype == np.bool_ else 0
                 narrow, lut, choice = narrow_column(
                     npcol, self._narrow_choice.get(idx)
                 )
+                del npcol
                 padded = pad_to(narrow, bucket, fill)
                 staged[idx] = (padded, lut, choice)
                 total_bytes += padded.nbytes + (0 if lut is None else lut.nbytes)
@@ -673,24 +723,125 @@ class FusedAggregateStage:
                 raise UnsupportedOnDevice(
                     f"stage batches ({total_bytes >> 20} MiB) exceed the HBM budget"
                 )
-            make_headroom(self, total_bytes, budget)
-            cols = _upload_staged(staged, self._narrow_choice)
             seg_bucket = bucket_rows(n_groups, 16) + 1  # +1 dump slot
             # group codes fit int16 by construction (n_groups <= MAX_GROUPS)
             codes_pad = pad_to(codes.astype(np.int16), bucket, 0)
             row_valid = np.zeros(bucket, dtype=np.bool_)
             row_valid[:n] = True
+            rec = {
+                "n_groups": int(n_groups),
+                "seg_bucket": int(seg_bucket),
+                "codes_pad": codes_pad,
+                "row_valid": row_valid,
+                "key_values": key_values,
+            }
+            if persisting:
+                records.append({**rec, "staged": dict(staged)})
+            make_headroom(self, total_bytes, budget)
+            cols = _upload_staged(staged, self._narrow_choice)
             entries.append(
                 {
-                    "n_groups": n_groups,
-                    "seg_bucket": int(seg_bucket),
+                    "n_groups": rec["n_groups"],
+                    "seg_bucket": rec["seg_bucket"],
                     "cols": cols,
                     "codes": jnp.asarray(codes_pad),
                     "row_valid": jnp.asarray(row_valid),
                     "key_values": key_values,
                 }
             )
+        if persisting and records:
+            self._save_batches_layout(partition, ctx, records)
         return entries
+
+    def _save_batches_layout(self, partition: int, ctx, records: List[dict]) -> None:
+        """Best-effort persist of the unrolled path's staged batches."""
+        from ballista_tpu.ops import layout_cache as lc
+
+        arrays: List[np.ndarray] = []
+        metas = []
+        for rec in records:
+            m = {
+                "n_groups": rec["n_groups"],
+                "seg_bucket": rec["seg_bucket"],
+                "cols": _pack_staged(rec["staged"], arrays),
+                "codes": len(arrays),
+            }
+            arrays.append(rec["codes_pad"])
+            m["row_valid"] = len(arrays)
+            arrays.append(rec["row_valid"])
+            m["keys"] = len(arrays)
+            arrays.append(lc.pack_arrow_arrays(rec["key_values"]))
+            metas.append(m)
+        dmeta, darrays = lc.pack_dict_snapshot(self.dicts)
+        offset = len(arrays)
+        meta = {
+            "kind": "batches",
+            "batches": metas,
+            "dicts": {k: v + offset for k, v in dmeta.items()},
+        }
+        arrays.extend(darrays)
+        meta["n_arrays"] = len(arrays)
+        lc.save_entry(
+            base=ctx.config.tpu_layout_cache_dir(),
+            stage_key=self.persist_key,
+            partition=partition,
+            meta=meta,
+            arrays=arrays,
+            cap_bytes=ctx.config.tpu_layout_cache_cap(),
+        )
+
+    def _load_batches_layout(self, meta: dict, arrays: List[np.ndarray],
+                             ctx) -> Optional[dict]:
+        """Rehydrate a persisted batches entry (meta pre-validated as
+        kind=batches with an adopted dictionary snapshot)."""
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops import layout_cache as lc
+
+        records: List[dict] = []
+        total = 0
+        try:
+            for m in meta["batches"]:
+                unpacked = _unpack_staged(
+                    m["cols"], arrays, self._narrow_choice
+                )
+                if unpacked is None:
+                    return None
+                staged, nbytes = unpacked
+                total += nbytes
+                records.append(
+                    {
+                        "n_groups": int(m["n_groups"]),
+                        "seg_bucket": int(m["seg_bucket"]),
+                        "staged": staged,
+                        "codes_pad": arrays[m["codes"]],
+                        "row_valid": arrays[m["row_valid"]],
+                        "key_values": lc.unpack_arrow_arrays(arrays[m["keys"]]),
+                    }
+                )
+                total += arrays[m["codes"]].nbytes + arrays[m["row_valid"]].nbytes
+        except Exception:
+            return None
+        budget = ctx.config.tpu_hbm_budget()
+        if total > budget:
+            raise UnsupportedOnDevice(
+                f"stage batches ({total >> 20} MiB) exceed the HBM budget"
+            )
+        make_headroom(self, total, budget)
+        entries: List[dict] = []
+        for rec in records:
+            cols = _upload_staged(rec["staged"], self._narrow_choice)
+            entries.append(
+                {
+                    "n_groups": rec["n_groups"],
+                    "seg_bucket": rec["seg_bucket"],
+                    "cols": cols,
+                    "codes": jnp.asarray(rec["codes_pad"]),
+                    "row_valid": jnp.asarray(rec["row_valid"]),
+                    "key_values": rec["key_values"],
+                }
+            )
+        return {"kind": "batches", "entries": entries}
 
     def _prepare_partition_sorted(self, partition: int, ctx) -> dict:
         """High-cardinality path: whole-partition chunked-segment layout
@@ -706,7 +857,7 @@ class FusedAggregateStage:
         The pallas kernel path is not persisted (config-gated, flat layout)."""
         from ballista_tpu.ops.layout import SortedSegmentLayout
 
-        loaded = self._load_sorted_layout(partition, ctx)
+        loaded = self._load_layout(partition, ctx, want=("sorted",))
         if loaded is not None:
             return loaded
         batches = [b for b in self._scan_batches(partition, ctx) if b.num_rows]
@@ -846,15 +997,7 @@ class FusedAggregateStage:
         arrays.append(layout.owner)
         meta["pad"] = len(arrays)
         arrays.append(layout.pad)
-        cols_meta = {}
-        for idx, (tiles, lut, choice) in staged.items():
-            spec = {"tiles": len(arrays), "choice": choice, "lut": None}
-            arrays.append(tiles)
-            if lut is not None:
-                spec["lut"] = len(arrays)
-                arrays.append(lut)
-            cols_meta[str(idx)] = spec
-        meta["cols"] = cols_meta
+        meta["cols"] = _pack_staged(staged, arrays)
         derived_meta = {}
         for name, (tiles, nkey, choice) in staged_derived.items():
             derived_meta[name] = {
@@ -874,11 +1017,11 @@ class FusedAggregateStage:
             ctx.config.tpu_layout_cache_cap(),
         )
 
-    def _load_sorted_layout(self, partition: int, ctx) -> Optional[dict]:
-        """Rehydrate a persisted sorted partition: adopt the dictionary
-        snapshot (live dicts must be a prefix — codes in the tiles must mean
-        the same strings), rebuild the layout from its scalars, and go
-        straight to the h2d transfer. Returns None on any miss/mismatch."""
+    def _load_layout(self, partition: int, ctx, want=("sorted", "batches")):
+        """Rehydrate a persisted partition of either kind: adopt the
+        dictionary snapshot (live dicts must be a prefix — codes in the
+        persisted arrays must mean the same strings), then go straight to
+        the h2d transfer. Returns None on any miss/mismatch."""
         base = ctx.config.tpu_layout_cache_dir()
         if not base or self.persist_key is None:
             return None
@@ -888,29 +1031,33 @@ class FusedAggregateStage:
         if hit is None:
             return None
         meta, arrays = hit
-        if meta.get("kind") != "sorted":
-            return None
-        if set(meta.get("derived", {})) != set(self.derive_columns):
+        if meta.get("kind") not in want:
             return None
         try:
             if not lc.adopt_dict_snapshot(self.dicts, meta["dicts"], arrays):
                 return None
+        except Exception:
+            return None
+        if meta["kind"] == "batches":
+            return self._load_batches_layout(meta, arrays, ctx)
+        return self._load_sorted_entry(meta, arrays, ctx)
+
+    def _load_sorted_entry(self, meta: dict, arrays, ctx) -> Optional[dict]:
+        from ballista_tpu.ops import layout_cache as lc
+
+        if set(meta.get("derived", {})) != set(self.derive_columns):
+            return None
+        try:
             from ballista_tpu.ops.layout import SortedSegmentLayout
 
             owner = arrays[meta["owner"]]
             pad = arrays[meta["pad"]]
             layout = SortedSegmentLayout.from_state(meta["layout"], owner, pad)
-            staged: Dict[int, tuple] = {}
-            total = pad.nbytes
-            for k, spec in meta["cols"].items():
-                idx = int(k)
-                tiles = arrays[spec["tiles"]]
-                lut = arrays[spec["lut"]] if spec["lut"] is not None else None
-                cur = self._narrow_choice.get(idx)
-                if cur is not None and cur != spec["choice"]:
-                    return None  # jitted step already compiled another dtype
-                staged[idx] = (tiles, lut, spec["choice"])
-                total += tiles.nbytes + (0 if lut is None else lut.nbytes)
+            unpacked = _unpack_staged(meta["cols"], arrays, self._narrow_choice)
+            if unpacked is None:
+                return None  # jitted step already compiled another dtype
+            staged, col_bytes = unpacked
+            total = pad.nbytes + col_bytes
             staged_derived: Dict[str, tuple] = {}
             for name, spec in meta["derived"].items():
                 nkey = spec["key"]
@@ -1046,7 +1193,7 @@ class FusedAggregateStage:
                     # persisted sorted layout first: a hit skips the whole
                     # scan+rank pass (the unrolled path would decode parquet
                     # before discovering the cardinality it declines on)
-                    prepared = self._load_sorted_layout(partition, ctx)
+                    prepared = self._load_layout(partition, ctx)
                     freshly_prepared = prepared is not None
                 if prepared is None:
                     try:

@@ -280,3 +280,47 @@ def test_disk_hit_pins_into_device_cache(tmp_path):
     assert stages and 0 in stages[0]._device_cache
     assert stages[0]._device_cache[0]["kind"] == "sorted"
     assert resident_bytes() > 0
+
+
+def test_batches_path_warm_start(tmp_path, monkeypatch):
+    """Low-cardinality stages (the unrolled batches path — q1/q6 shapes)
+    persist too: at SF=100 their full-scan decode is ~400 s per fresh
+    process, which would eat a relay capture window."""
+    rng = np.random.default_rng(4)
+    n = 80_000
+    table = pa.table(
+        {
+            "g": pa.array([f"grp{i % 5}" for i in rng.integers(0, 5, n)]),
+            "v": pa.array(rng.uniform(-10, 10, n)),
+            "w": pa.array(rng.integers(0, 1000, n), type=pa.int64()),
+        }
+    )
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(table, path)
+    cache = tmp_path / "layouts"
+    q = ("select g, sum(v) as sv, count(*) as c, sum(w) as sw from t "
+         "where v > -5 group by g order by g")
+
+    def run():
+        ctx = _ctx(cache)
+        ctx.register_parquet("t", path)
+        return ctx.sql(q).collect()
+
+    cold = run()
+    import json as _json
+
+    metas = [_json.load(open(p)) for p in cache.rglob("meta.json")]
+    assert any(m.get("kind") == "batches" for m in metas), metas
+    _reset_stage_caches()
+
+    real_read = pq.read_table
+
+    def _no_decode(*a, **kw):
+        raise AssertionError("parquet decode on a warm start")
+
+    monkeypatch.setattr(pq, "read_table", _no_decode)
+    try:
+        warm = run()
+    finally:
+        monkeypatch.setattr(pq, "read_table", real_read)
+    assert warm.equals(cold)
