@@ -575,11 +575,15 @@ class FactAggregateStage:
         inner = self.inner
         filter_masks = inner.filter_masks
 
-        @jax.jit
-        def step_sec(cols, aux, pad, m_tiles, p_rank, allowed):
+        from functools import partial as _partial
+
+        from ballista_tpu.ops.stage import jnp_expand_clen
+
+        @_partial(jax.jit, static_argnums=(0,))
+        def step_sec(L1, cols, aux, clen, m_tiles, p_rank, allowed):
             cols = widen_cols(cols)  # narrow residency -> canonical dtypes
             m_tiles = m_tiles.astype(jnp.int32)  # derived tiles ride narrow
-            mask0 = pad
+            mask0 = jnp_expand_clen(clen, L1)
             for fm in filter_masks:
                 mask0 = jnp.logical_and(mask0, fm(cols, aux))
             outs = []
@@ -637,7 +641,8 @@ class FactAggregateStage:
         aux = [jnp.asarray(a) for a in self.inner.compiler.build_aux()]
         packed = np.asarray(
             self._sec_step(
-                ent["cols"], aux, ent["pad"], ent["derived"]["sec_attr"],
+                ent["layout"].L1, ent["cols"], aux, ent["clen"],
+                ent["derived"]["sec_attr"],
                 jnp.asarray(p_rank), jnp.asarray(allowed_pad),
             )
         )
@@ -718,9 +723,11 @@ class FactAggregateStage:
                 gidx = bidx[ci // B] * B + ci % B
                 return vals, gidx
 
-            @jax.jit
-            def step_topk(cols, aux, pad, member_bits):
-                stacked = core(cols, aux, pad)  # [R_packed, G]
+            from functools import partial as _partial
+
+            @_partial(jax.jit, static_argnums=(0,))
+            def step_topk(L1, cols, aux, clen, member_bits):
+                stacked = core(L1, cols, aux, clen)  # [R_packed, G]
                 G = stacked.shape[1]
                 # little-endian bit unpack (host: np.packbits bitorder="little")
                 bits = (member_bits[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
@@ -758,9 +765,11 @@ class FactAggregateStage:
 
             return step_topk
 
-        @jax.jit
-        def step_select(cols, aux, pad, positions):
-            stacked = core(cols, aux, pad)
+        from functools import partial as _partial
+
+        @_partial(jax.jit, static_argnums=(0,))
+        def step_select(L1, cols, aux, clen, positions):
+            stacked = core(L1, cols, aux, clen)
             return jnp.take(stacked, positions, axis=1)
 
         return step_select
@@ -879,7 +888,8 @@ class FactAggregateStage:
             member[member_ranks] = True
             bits = np.packbits(member, bitorder="little")
             packed = np.asarray(
-                self._fact_step(ent["cols"], aux, ent["pad"], jnp.asarray(bits))
+                self._fact_step(ent["layout"].L1, ent["cols"], aux,
+                                ent["clen"], jnp.asarray(bits))
             )
             sel, scores, valid = packed[:-4], packed[-4], packed[-1] > 0
             idx = (
@@ -933,7 +943,8 @@ class FactAggregateStage:
             positions.astype(np.int32), bucket_rows(n_pos, 16), 0
         )
         sel = np.asarray(
-            self._fact_step(ent["cols"], aux, ent["pad"], jnp.asarray(pos_pad))
+            self._fact_step(ent["layout"].L1, ent["cols"], aux, ent["clen"],
+                            jnp.asarray(pos_pad))
         )[:, :n_pos]
         rows = self._decode(sel)
         keep = rows[0] > 0

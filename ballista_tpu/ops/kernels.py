@@ -165,7 +165,15 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         return stage.run(partition, ctx)
     except UnsupportedOnDevice:
         # permanently declined: free its pinned device entries and their
-        # HBM-budget reservations before dropping the stage
+        # HBM-budget reservations before dropping the stage. Log WHY once —
+        # a silent decline (e.g. tiles just past the HBM budget) reads as
+        # "device path ran" in benchmarks when it did not.
+        import logging
+        import sys
+
+        logging.getLogger("ballista.tpu").warning(
+            "device stage permanently declined to host: %s", sys.exc_info()[1]
+        )
         from ballista_tpu.ops.runtime import release_stage_residency
 
         release_stage_residency(stage)

@@ -99,16 +99,21 @@ class SortedSegmentLayout:
                 f"partition of {len(codes)} rows exceeds int32 row indexing"
             )
         idx = cstart.astype(np.int32)[:, None] + np.arange(L1, dtype=np.int32)[None, :]
-        pad = np.arange(L1, dtype=np.int64)[None, :] < clen[:, None]
-        idx = np.where(pad, idx, 0)
+        idx = np.where(
+            np.arange(L1, dtype=np.int32)[None, :] < clen[:, None], idx, 0
+        )
 
         self.n_groups = n_groups
         self.L1 = L1
         self.V = V
+        # valid-row count per chunk; the [V, L1] boolean mask it implies is
+        # expanded IN-PROGRAM (arange(L1) < clen[:, None]) — shipping the
+        # bool tiles cost 1 byte/slot of HBM (1.05 GB at SF=100, exactly
+        # the margin that pushed q5 past the budget)
+        self.clen = clen.astype(np.int16)
         # take-index into ORIGINAL row positions
         self.row_take = order.astype(np.int32)[idx.reshape(-1)].reshape(V, L1)
         del idx
-        self.pad = pad  # bool [V, L1]
         self.owner = owner  # sorted [V]
         # fold_*'s reduceat bookkeeping assumes every group owns >=1 chunk;
         # min_one_chunk=False layouts fold in-program instead (mesh path)
@@ -130,8 +135,14 @@ class SortedSegmentLayout:
             "one_chunk_per_group": bool(self.one_chunk_per_group),
         }
 
+    @property
+    def pad(self) -> np.ndarray:
+        """Bool [V, L1] valid-slot mask, expanded from clen on demand
+        (device programs expand it in-program instead of shipping it)."""
+        return np.arange(self.L1, dtype=np.int32)[None, :] < self.clen[:, None]
+
     @classmethod
-    def from_state(cls, meta: dict, owner: np.ndarray, pad: np.ndarray):
+    def from_state(cls, meta: dict, owner: np.ndarray, clen: np.ndarray):
         """Rehydrate a layout from persisted state; supports every
         post-materialize consumer (fold_*, one_chunk_per_group checks) but
         not materialize()."""
@@ -140,7 +151,7 @@ class SortedSegmentLayout:
         self.L1 = int(meta["L1"])
         self.V = int(meta["V"])
         self.owner = owner
-        self.pad = pad
+        self.clen = clen.astype(np.int16)
         self.row_take = None  # materialize() unsupported after rehydration
         self._host_folds = bool(meta["host_folds"])
         self.one_chunk_per_group = bool(meta["one_chunk_per_group"])
@@ -152,7 +163,7 @@ class SortedSegmentLayout:
 
     def materialize(self, col: np.ndarray) -> np.ndarray:
         """Lay a row-space column out as [V, L1] tiles (pad slots carry row
-        0's value; every consumer masks with .pad)."""
+        0's value; every consumer masks with the clen-derived pad)."""
         return col[self.row_take.reshape(-1)].reshape(self.V, self.L1)
 
     # ------------------------------------------------------------------

@@ -100,6 +100,14 @@ def jnp_unpack_i32(hi, lo):
     return hi.astype(jnp.int32) * 65536 + lo.astype(jnp.int32)
 
 
+def jnp_expand_clen(clen, L1: int):
+    """In-program [V, L1] valid-slot mask from per-chunk lengths — 16× less
+    HBM than shipping the bool tiles (which cost q5 SF=100 its budget)."""
+    import jax.numpy as jnp
+
+    return jnp.arange(L1, dtype=jnp.int16)[None, :] < clen[:, None]
+
+
 def dense_rank(encoded: List[Tuple[np.ndarray, int]]):
     """Combine per-column dictionary codes into dense row ranks.
 
@@ -480,23 +488,26 @@ class FusedAggregateStage:
         return step
 
     def _build_sorted_step(self):
+        import functools as _ft
+
         import jax
 
-        return jax.jit(self._sorted_core())
+        return _ft.partial(jax.jit, static_argnums=(0,))(self._sorted_core())
 
     def _sorted_core(self):
         """Unjitted device program for the chunked-segment layout
         (ops/layout.py): elementwise exprs over [V, L1] tiles, axis-1
-        reductions to per-chunk partials. O(N) for any group count.
-        FactAggregateStage composes this with a membership/top-k epilogue
-        inside one jit."""
+        reductions to per-chunk partials. O(N) for any group count. The
+        valid-slot mask expands in-program from per-chunk lengths (L1 is
+        the static first argument). FactAggregateStage composes this with
+        a membership/top-k epilogue inside one jit."""
         import jax.numpy as jnp
 
         filter_masks = self.filter_masks
 
-        def sstep(cols, aux, pad):
+        def sstep(L1, cols, aux, clen):
             cols = widen_cols(cols)  # narrow residency -> canonical dtypes
-            mask = pad
+            mask = jnp_expand_clen(clen, L1)
             for fm in filter_masks:
                 mask = jnp.logical_and(mask, fm(cols, aux))
             return self._emit_rows(
@@ -899,7 +910,7 @@ class FusedAggregateStage:
         # Row-space columns free as their tiles materialize: the peak holds
         # one column in row space, not every used column at once.
         staged: Dict[int, tuple] = {}
-        total = layout.pad.nbytes
+        total = layout.clen.nbytes
         for idx in list(npcols):
             npcol = npcols.pop(idx)
             narrow, lut, choice = narrow_column(npcol, self._narrow_choice.get(idx))
@@ -968,7 +979,7 @@ class FusedAggregateStage:
             "kind": "sorted",
             "layout": layout,
             "cols": cols,
-            "pad": jnp.asarray(np.ascontiguousarray(layout.pad)),
+            "clen": jnp.asarray(layout.clen),
             "key_values": key_values,
             "n_groups": layout.n_groups,
             "derived": derived,
@@ -995,8 +1006,8 @@ class FusedAggregateStage:
         meta: Dict = {"kind": "sorted", "layout": layout.state()}
         meta["owner"] = len(arrays)
         arrays.append(layout.owner)
-        meta["pad"] = len(arrays)
-        arrays.append(layout.pad)
+        meta["clen"] = len(arrays)
+        arrays.append(layout.clen)
         meta["cols"] = _pack_staged(staged, arrays)
         derived_meta = {}
         for name, (tiles, nkey, choice) in staged_derived.items():
@@ -1051,13 +1062,16 @@ class FusedAggregateStage:
             from ballista_tpu.ops.layout import SortedSegmentLayout
 
             owner = arrays[meta["owner"]]
-            pad = arrays[meta["pad"]]
-            layout = SortedSegmentLayout.from_state(meta["layout"], owner, pad)
+            if "clen" in meta:
+                clen = arrays[meta["clen"]]
+            else:  # legacy entry: bool [V, L1] pad tiles
+                clen = arrays[meta["pad"]].sum(axis=1).astype(np.int16)
+            layout = SortedSegmentLayout.from_state(meta["layout"], owner, clen)
             unpacked = _unpack_staged(meta["cols"], arrays, self._narrow_choice)
             if unpacked is None:
                 return None  # jitted step already compiled another dtype
             staged, col_bytes = unpacked
-            total = pad.nbytes + col_bytes
+            total = clen.nbytes + col_bytes
             staged_derived: Dict[str, tuple] = {}
             for name, spec in meta["derived"].items():
                 nkey = spec["key"]
@@ -1262,7 +1276,9 @@ class FusedAggregateStage:
 
     def _run_sorted(self, ent: dict, aux) -> pa.Table:
         layout = ent["layout"]
-        stacked = np.asarray(self._sorted_step(ent["cols"], aux, ent["pad"]))
+        stacked = np.asarray(
+            self._sorted_step(ent["layout"].L1, ent["cols"], aux, ent["clen"])
+        )
         rows = self._decode_stacked(stacked)
         folds = {"sum": layout.fold_sum, "min": layout.fold_min,
                  "max": layout.fold_max}

@@ -95,21 +95,24 @@ def global_max(v: int) -> int:
 
 
 def make_sharded(mesh, blocks: dict, total_len: int, dtype) -> object:
-    """Assemble a globally-sharded 1-D array from this process's per-shard
-    blocks. blocks: flat shard id -> np.ndarray of length total_len // n.
-    Every shard id this process owns must be present."""
+    """Assemble a globally-sharded array (1-D or N-D, sharded on axis 0)
+    from this process's per-shard blocks. blocks: flat shard id ->
+    np.ndarray with total_len // n leading rows (trailing dims equal on
+    every block). Every shard id this process owns must be present."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     n = int(np.prod(list(mesh.shape.values())))
     block = total_len // n
+    mine = local_shard_ids(mesh)
+    trailing = blocks[mine[0]].shape[1:] if mine else ()
     sharding = NamedSharding(mesh, P(tuple(mesh.shape.keys())[0]))
     devs = list(mesh.devices.flat)
     arrays = []
-    for i in local_shard_ids(mesh):
+    for i in mine:
         b = blocks[i]
-        assert len(b) == block, (len(b), block)
+        assert b.shape == (block,) + trailing, (b.shape, block, trailing)
         arrays.append(jax.device_put(b.astype(dtype, copy=False), devs[i]))
     return jax.make_array_from_single_device_arrays(
-        (total_len,), sharding, arrays
+        (total_len,) + trailing, sharding, arrays
     )

@@ -362,12 +362,13 @@ class SpmdAggregateExec(ExecutionPlan):
         collective (multihost.agree): a unilateral fallback would leave
         the other hosts blocked inside the program's collectives.
 
-        v1 scope (collectively enforced): integer/date/bool group keys
-        (the key union rides an int64 allgather), no string columns
-        anywhere in the stage (per-host dictionary growth would diverge
-        the aux shapes), G <= MAX_GROUPS (the unrolled program). The
-        reference reaches multi-node scale with one executor process per
-        node over NCCL/MPI; this is the mesh-native equivalent."""
+        Scope (collectively enforced): integer/date/bool group keys (the
+        key union rides an int64 allgather) and no string columns anywhere
+        in the stage (per-host dictionary growth would diverge the aux
+        shapes). Both the unrolled (G <= MAX_GROUPS) and the sorted
+        chunked-segment (any G) programs run at pod scale. The reference
+        reaches multi-node scale with one executor process per node over
+        NCCL/MPI; this is the mesh-native equivalent."""
         import jax
         import jax.numpy as jnp
 
@@ -454,12 +455,6 @@ class SpmdAggregateExec(ExecutionPlan):
                 d["gcodes"] = mapping[d["codes"]].astype(np.int32)
             gkv = _rebuild_key_arrays(stage, gathered, first_idx, n_keys)
 
-        ok = n_groups <= MAX_GROUPS
-        if not mh.agree(ok):
-            raise UnsupportedOnDevice(
-                "multi-host sorted path not yet supported (G > MAX_GROUPS)"
-            )
-
         # ---- int-overflow check over the GLOBAL row count --------------
         ok = True
         try:
@@ -471,6 +466,13 @@ class SpmdAggregateExec(ExecutionPlan):
             ok = False
         if not mh.agree(ok):
             raise UnsupportedOnDevice("multi-host int-range decline")
+
+        if n_groups > MAX_GROUPS:
+            # n_groups derives from the SAME gathered union on every host,
+            # so the path choice needs no extra agreement
+            return self._multihost_sorted(
+                ctx, stage, mesh, n_dev, local, gkv, n_groups
+            )
 
         # ---- assemble globally-sharded blocks; run the SAME program ----
         local_max = max(
@@ -508,6 +510,111 @@ class SpmdAggregateExec(ExecutionPlan):
         seg = int(bucket_rows(n_groups, 16)) + 1
         program = self._get_program(mesh, stage, seg, set(cols.keys()), len(aux))
         stacked = np.asarray(program(cols, aux, codes_g, valid_g))
+        rows = stage._decode_stacked(stacked)
+        counts_np = rows[0][:n_groups]
+        outputs = [r[:n_groups] for r in rows[1:]]
+        partial_table = stage._assemble_partial(outputs, counts_np, gkv, n_groups)
+        return self.final._final(partial_table)
+
+    def _multihost_sorted(self, ctx, stage, mesh, n_dev, local, gkv,
+                          n_groups) -> pa.Table:
+        """Pod path for G > MAX_GROUPS: per-shard sorted chunked-segment
+        tiles built host-locally, tile widths (L1) and chunk counts (V)
+        unified with collective maxima so every shard's [V_pad, L1] blocks
+        stack into one globally-sharded array, then the SAME jitted sorted
+        shard_map program (segment fold + psum/pmin/pmax) runs over the
+        global mesh — the cardinality-independent layout at pod scale."""
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.layout import SortedSegmentLayout
+        from ballista_tpu.ops.runtime import UnsupportedOnDevice, bucket_rows
+        from ballista_tpu.parallel import multihost as mh
+
+        my_shards = mh.local_shard_ids(mesh)
+        # fallible per-host work is fenced with collective agreement BEFORE
+        # the next collective (multihost.py's invariant): a unilateral
+        # raise here (oversized shard, MemoryError while materializing)
+        # would strand the other hosts inside the collectives below
+        ok = True
+        layouts: Dict[int, SortedSegmentLayout] = {}
+        try:
+            for si, d in local.items():
+                layouts[si] = SortedSegmentLayout(
+                    d["gcodes"], n_groups, min_one_chunk=False
+                )
+        except (UnsupportedOnDevice, MemoryError):
+            ok = False
+        if not mh.agree(ok):
+            raise UnsupportedOnDevice("multi-host sorted layout decline")
+        my_L1 = max((l.L1 for l in layouts.values()), default=8)
+        L1 = mh.global_max(my_L1)
+        my_V = 1
+        col_ids = sorted(stage.compiler.used_columns)
+        ok = True
+        col_blocks: Dict[int, Dict[int, np.ndarray]] = {}
+        clen_blocks: Dict[int, np.ndarray] = {}
+        owner_blocks: Dict[int, np.ndarray] = {}
+        try:
+            for si in list(layouts):
+                if layouts[si].L1 != L1:
+                    layouts[si] = SortedSegmentLayout(
+                        local[si]["gcodes"], n_groups, force_L1=L1,
+                        min_one_chunk=False,
+                    )
+            my_V = max((l.V for l in layouts.values()), default=1)
+        except (UnsupportedOnDevice, MemoryError):
+            ok = False
+        if not mh.agree(ok):
+            raise UnsupportedOnDevice("multi-host sorted rebuild decline")
+        V_pad = mh.global_max(int(bucket_rows(my_V, 8)))
+        G_pad = int(bucket_rows(n_groups, 16))
+        ok = True
+        try:
+            for idx in col_ids:
+                np_dtype = _np_dtype_for(stage.compiler.used_columns[idx])
+                blocks = {}
+                for si in my_shards:
+                    big = np.zeros((V_pad, L1), dtype=np_dtype)
+                    l = layouts.get(si)
+                    if l is not None and l.V:
+                        big[: l.V] = l.materialize(
+                            local[si]["npcols"][idx].astype(
+                                np_dtype, copy=False
+                            )
+                        )
+                    blocks[si] = big
+                col_blocks[idx] = blocks
+            for si in my_shards:
+                cb = np.zeros(V_pad, dtype=np.int16)
+                # padding chunks carry identity partials (clen=0); G_pad-1
+                # keeps each shard's owner slice sorted
+                # (indices_are_sorted=True)
+                ob = np.full(V_pad, G_pad - 1, dtype=np.int32)
+                l = layouts.get(si)
+                if l is not None and l.V:
+                    cb[: l.V] = l.clen
+                    ob[: l.V] = l.owner
+                clen_blocks[si] = cb
+                owner_blocks[si] = ob
+        except (UnsupportedOnDevice, MemoryError):
+            ok = False
+        if not mh.agree(ok):
+            raise UnsupportedOnDevice("multi-host tile materialization decline")
+
+        aux = [jnp.asarray(a) for a in stage.compiler.build_aux()]
+        cols: Dict[int, object] = {}
+        for idx in col_ids:
+            np_dtype = _np_dtype_for(stage.compiler.used_columns[idx])
+            cols[idx] = mh.make_sharded(
+                mesh, col_blocks.pop(idx), V_pad * n_dev, np_dtype
+            )
+        clen_g = mh.make_sharded(mesh, clen_blocks, V_pad * n_dev, np.int16)
+        owner_g = mh.make_sharded(mesh, owner_blocks, V_pad * n_dev, np.int32)
+
+        program = self._get_sorted_program(
+            mesh, stage, G_pad, L1, set(cols.keys()), len(aux)
+        )
+        stacked = np.asarray(program(cols, aux, clen_g, owner_g))
         rows = stage._decode_stacked(stacked)
         counts_np = rows[0][:n_groups]
         outputs = [r[:n_groups] for r in rows[1:]]
@@ -590,21 +697,21 @@ class SpmdAggregateExec(ExecutionPlan):
                         d["npcols"][idx]
                     )
             cols[idx] = jnp.asarray(big)
-        pad_big = np.zeros((n_dev * V_pad, L1), dtype=np.bool_)
-        # padding chunks carry identity partials (pad=False), so any segment
-        # may absorb them — use G_pad-1 to keep each shard's owner slice
-        # SORTED (the segment ops are called with indices_are_sorted=True)
+        clen_big = np.zeros(n_dev * V_pad, dtype=np.int16)
+        # padding chunks carry identity partials (clen=0 -> empty mask), so
+        # any segment may absorb them — use G_pad-1 to keep each shard's
+        # owner slice SORTED (segment ops run indices_are_sorted=True)
         owner_big = np.full(n_dev * V_pad, G_pad - 1, dtype=np.int32)
         for si, l in enumerate(layouts):
             if l is not None and l.V:
-                pad_big[si * V_pad: si * V_pad + l.V] = l.pad
+                clen_big[si * V_pad: si * V_pad + l.V] = l.clen
                 owner_big[si * V_pad: si * V_pad + l.V] = l.owner
 
         program = self._get_sorted_program(
-            mesh, stage, G_pad, set(cols.keys()), len(aux)
+            mesh, stage, G_pad, L1, set(cols.keys()), len(aux)
         )
         stacked = np.asarray(
-            program(cols, aux, jnp.asarray(pad_big), jnp.asarray(owner_big))
+            program(cols, aux, jnp.asarray(clen_big), jnp.asarray(owner_big))
         )
         rows = stage._decode_stacked(stacked)
         return rows[0][:n_groups], [r[:n_groups] for r in rows[1:]]
@@ -666,13 +773,14 @@ class SpmdAggregateExec(ExecutionPlan):
         self._program_key = key
         return self._program
 
-    def _get_sorted_program(self, mesh, stage, G_pad: int, col_keys, n_aux: int):
+    def _get_sorted_program(self, mesh, stage, G_pad: int, L1: int, col_keys,
+                            n_aux: int):
         """shard_map(per-shard tile partials -> sorted segment fold to dense
         [G_pad]) + psum/pmin/pmax exchange, jitted once per (group bucket,
         column set). Chunk owners are sorted within each shard, and V is
         orders of magnitude smaller than the row count, so the in-program
         segment ops stay cheap even though XLA lowers them to scatter."""
-        key = ("sorted", G_pad, tuple(sorted(col_keys)), n_aux)
+        key = ("sorted", G_pad, L1, tuple(sorted(col_keys)), n_aux)
         if self._program_key == key:
             return self._program
 
@@ -691,8 +799,8 @@ class SpmdAggregateExec(ExecutionPlan):
         collectives = {"sum": jax.lax.psum, "min": jax.lax.pmin,
                        "max": jax.lax.pmax}
 
-        def per_shard(cols, aux, pad, owner):
-            stacked = core(cols, aux, pad)  # [R_packed, V] chunk partials
+        def per_shard(cols, aux, clen, owner):
+            stacked = core(L1, cols, aux, clen)  # [R_packed, V] chunk partials
             outs = []
             p = 0
             for is_int, fold in zip(int_rows, folds):

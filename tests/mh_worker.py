@@ -59,7 +59,10 @@ def main() -> None:
     )
     ctx = ExecutionContext(cfg)
     ctx.register_parquet("t", data_dir)
-    key = "k" if query == "int_keys" else "s"
+    # int_keys: low-cardinality (unrolled program); highcard: the sorted
+    # chunked-segment program (hk has thousands of groups); string_keys:
+    # collective decline to host
+    key = {"int_keys": "k", "highcard": "hk", "string_keys": "s"}[query]
     df = ctx.table("t").aggregate(
         [col(key)],
         [F.sum(col("v")).alias("sv"), F.count(col("v")).alias("c"),

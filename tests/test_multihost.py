@@ -37,6 +37,7 @@ def _dataset(tmp_path, seed=5):
         t = pa.table(
             {
                 "k": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+                "hk": pa.array(rng.integers(0, 5000, n), type=pa.int64()),
                 "s": pa.array([f"s{i % 6}" for i in range(n)]),
                 "v": pa.array(rng.uniform(-10, 10, n)),
                 "w": pa.array(rng.integers(-100, 100, n), type=pa.int64()),
@@ -48,22 +49,37 @@ def _dataset(tmp_path, seed=5):
 
 
 def _run_workers(data_dir, query):
+    """Workers write to FILES, not pipes: a >64 KB result JSON would fill
+    the pipe while this parent drains workers sequentially — the blocked
+    writer then never reaches jax.distributed.shutdown and the coordination
+    barrier kills the whole pod at its 300 s timeout."""
     port = _free_port()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(os.path.dirname(__file__),
-                                          "mh_worker.py"),
-             str(pid), "2", str(port), str(data_dir), query],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env={k: v for k, v in os.environ.items()
-                 if k not in ("XLA_FLAGS", "JAX_PLATFORMS")},
+    base = str(data_dir)
+    procs = []
+    for pid in range(2):
+        fo = open(f"{base}.out{pid}", "w")
+        fe = open(f"{base}.err{pid}", "w")
+        procs.append(
+            (
+                subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(os.path.dirname(__file__), "mh_worker.py"),
+                     str(pid), "2", str(port), str(data_dir), query],
+                    stdout=fo, stderr=fe,
+                    env={k: v for k, v in os.environ.items()
+                         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")},
+                ),
+                fo, fe,
+            )
         )
-        for pid in range(2)
-    ]
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+    for pid, (p, fo, fe) in enumerate(procs):
+        rc = p.wait(timeout=300)
+        fo.close()
+        fe.close()
+        err = open(f"{base}.err{pid}").read()
+        assert rc == 0, f"worker {pid} failed:\n{err[-3000:]}"
+        out = open(f"{base}.out{pid}").read()
         outs.append(json.loads(out.strip().splitlines()[-1]))
     return outs
 
@@ -129,3 +145,27 @@ def test_partition_ownership_contract():
     assert [mh.partition_shard(p, 8) for p in range(10)] == [
         0, 1, 2, 3, 4, 5, 6, 7, 0, 1,
     ]
+
+
+def test_two_process_highcard_sorted_program(tmp_path):
+    """G > MAX_GROUPS on the pod: each process builds its shards' sorted
+    chunked-segment tiles with collectively-unified L1/V, and the sorted
+    shard_map program (segment fold + psum) runs over the global mesh."""
+    d, full = _dataset(tmp_path)
+    outs = _run_workers(d, "highcard")
+    assert [o["path"] for o in outs] == ["mesh", "mesh"]
+    assert outs[0]["result"] == outs[1]["result"]
+    r0 = set(outs[0]["read_partitions"])
+    r1 = set(outs[1]["read_partitions"])
+    assert r0.isdisjoint(r1) and r0 | r1 == set(range(N_PARTS))
+
+    oracle = _oracle(full, "hk")
+    res = outs[0]["result"]
+    assert len(res["hk"]) > 1024, "not a sorted-path cardinality"
+    assert res["hk"] == oracle["hk"]
+    assert res["c"] == oracle["c"]
+    assert res["sw"] == oracle["sw"]
+    # atol: f32 sums of +/-10 values cancel toward zero, where rtol alone
+    # explodes on a 3e-5 absolute difference
+    np.testing.assert_allclose(res["sv"], oracle["sv"], rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(res["mn"], oracle["mn"], rtol=1e-5)
