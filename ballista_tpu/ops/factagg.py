@@ -575,11 +575,8 @@ class FactAggregateStage:
         inner = self.inner
         filter_masks = inner.filter_masks
 
-        from functools import partial as _partial
-
         from ballista_tpu.ops.stage import jnp_expand_clen
 
-        @_partial(jax.jit, static_argnums=(0,))
         def step_sec(L1, cols, aux, clen, m_tiles, p_rank, allowed):
             cols = widen_cols(cols)  # narrow residency -> canonical dtypes
             m_tiles = m_tiles.astype(jnp.int32)  # derived tiles ride narrow
@@ -603,7 +600,7 @@ class FactAggregateStage:
                 )
             return jnp.stack(outs, axis=1)  # [R_packed, GA_pad]
 
-        return step_sec
+        return jax.jit(step_sec, static_argnums=(0,))
 
     def _run_secondary(self, ent: dict, ctx) -> pa.Table:
         import jax.numpy as jnp
@@ -723,9 +720,6 @@ class FactAggregateStage:
                 gidx = bidx[ci // B] * B + ci % B
                 return vals, gidx
 
-            from functools import partial as _partial
-
-            @_partial(jax.jit, static_argnums=(0,))
             def step_topk(L1, cols, aux, clen, member_bits):
                 stacked = core(L1, cols, aux, clen)  # [R_packed, G]
                 G = stacked.shape[1]
@@ -763,16 +757,13 @@ class FactAggregateStage:
                     ]
                 )
 
-            return step_topk
+            return jax.jit(step_topk, static_argnums=(0,))
 
-        from functools import partial as _partial
-
-        @_partial(jax.jit, static_argnums=(0,))
         def step_select(L1, cols, aux, clen, positions):
             stacked = core(L1, cols, aux, clen)
             return jnp.take(stacked, positions, axis=1)
 
-        return step_select
+        return jax.jit(step_select, static_argnums=(0,))
 
     # ------------------------------------------------------------------
     def _dim_side(self, ctx) -> dict:

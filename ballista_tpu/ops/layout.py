@@ -135,12 +135,6 @@ class SortedSegmentLayout:
             "one_chunk_per_group": bool(self.one_chunk_per_group),
         }
 
-    @property
-    def pad(self) -> np.ndarray:
-        """Bool [V, L1] valid-slot mask, expanded from clen on demand
-        (device programs expand it in-program instead of shipping it)."""
-        return np.arange(self.L1, dtype=np.int32)[None, :] < self.clen[:, None]
-
     @classmethod
     def from_state(cls, meta: dict, owner: np.ndarray, clen: np.ndarray):
         """Rehydrate a layout from persisted state; supports every

@@ -488,11 +488,9 @@ class FusedAggregateStage:
         return step
 
     def _build_sorted_step(self):
-        import functools as _ft
-
         import jax
 
-        return _ft.partial(jax.jit, static_argnums=(0,))(self._sorted_core())
+        return jax.jit(self._sorted_core(), static_argnums=(0,))
 
     def _sorted_core(self):
         """Unjitted device program for the chunked-segment layout

@@ -8,7 +8,11 @@ import os
 import pyarrow as pa
 import pytest
 
+import numpy as np
+
 from ballista_tpu.client import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
 from ballista_tpu.executor.runtime import StandaloneCluster
 from ballista_tpu.logical import col, functions as F, lit
 
@@ -336,6 +340,59 @@ def test_all_22_queries_through_cluster(tmp_path):
                     )
                 else:
                     assert list(g[cn]) == list(w[cn]), f"{q}.{cn}"
+        c.close()
+    finally:
+        cluster.shutdown()
+
+
+def test_distributed_tpch_with_spmd_fusion(tmp_path):
+    """End-to-end through the REAL control plane with SPMD stage fusion on:
+    the scheduler's DistributedPlanner emits SpmdAggregateExec/SpmdJoinExec,
+    the nodes travel the wire as PhySpmd* protos, and the executor runs the
+    mesh programs (8-device CPU mesh). Results must match the local host
+    backend on real TPC-H queries (q12 exercises the mapped device stage,
+    q3 the fact-agg pushdown under a fused co-partitioned join tree)."""
+    from benchmarks.tpch.datagen import generate, register_all
+    from ballista_tpu.utils import tracing
+
+    d = tmp_path / "tpch"
+    generate(str(d), sf=0.02, parts=2)
+    settings = {
+        "ballista.executor.backend": "tpu",
+        "ballista.tpu.spmd_stages": "true",
+        "ballista.tpu.mesh": "data:8",
+    }
+    cluster = StandaloneCluster(
+        n_executors=2, config=BallistaConfig(settings)
+    )
+    try:
+        host, port = cluster.scheduler_addr
+        c = BallistaContext(host, port, settings=settings)
+        register_all(c, str(d))
+        local = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": "cpu"})
+        )
+        register_all(local, str(d))
+        tracing.reset()
+        for q in ("q12", "q3"):
+            sql = open(f"benchmarks/tpch/queries/{q}.sql").read()
+            got = c.sql(sql).collect().to_pydict()
+            want = local.sql(sql).collect().to_pydict()
+            assert list(got) == list(want), q
+            for k in got:
+                a, b = got[k], want[k]
+                if a and isinstance(a[0], float):
+                    np.testing.assert_allclose(a, b, rtol=1e-3, err_msg=q)
+                else:
+                    assert a == b, (q, k)
+        # the mesh paths must actually have run — the host fallback
+        # produces identical rows, so results alone cannot catch a silent
+        # regression (observed healthy: join_mesh=3, mesh=2, fallbacks=0)
+        counters = tracing.counters()
+        assert counters.get("spmd.join_mesh", 0) >= 1, counters
+        assert counters.get("spmd.mesh", 0) >= 1, counters
+        assert counters.get("spmd.host_fallback", 0) == 0, counters
+        assert counters.get("spmd.join_host_fallback", 0) == 0, counters
         c.close()
     finally:
         cluster.shutdown()
