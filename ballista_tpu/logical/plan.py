@@ -157,10 +157,16 @@ class Aggregate(LogicalPlan):
         input: LogicalPlan,
         group_exprs: List[Expr],
         aggr_exprs: List[Expr],
+        exact_floats: bool = False,
     ) -> None:
         self.input = input
         self.group_exprs = group_exprs
         self.aggr_exprs = aggr_exprs
+        # a decorrelated scalar subquery's result is compared against
+        # source values (q2: ps_supplycost = MIN(ps_supplycost)); float
+        # MIN/MAX must then return the bit-exact stored value, which the
+        # f32 device paths cannot — they decline when this is set
+        self.exact_floats = exact_floats
         in_schema = input.schema()
         fields = [e.to_field(in_schema) for e in group_exprs]
         fields += [e.to_field(in_schema) for e in aggr_exprs]
@@ -173,7 +179,8 @@ class Aggregate(LogicalPlan):
         return [self.input]
 
     def with_children(self, children: List[LogicalPlan]) -> "Aggregate":
-        return Aggregate(children[0], self.group_exprs, self.aggr_exprs)
+        return Aggregate(children[0], self.group_exprs, self.aggr_exprs,
+                         exact_floats=self.exact_floats)
 
     def expressions(self) -> List[Expr]:
         return list(self.group_exprs) + list(self.aggr_exprs)

@@ -103,8 +103,17 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     flags = (
         f"fv={ctx.config.tpu_fuse_volatile()},dc={ctx.config.device_cache()},"
         f"sk={ctx.config.tpu_sorted_kernel()},"
-        f"topk={getattr(exec_node, '_topk_pushdown', None)}"
+        f"topk={getattr(exec_node, '_topk_pushdown', None)},"
+        f"ef={getattr(exec_node, 'exact_floats', False)}"
     )
+    # decorrelated scalar subqueries equality-compare the aggregate result
+    # against source values (q2: ps_supplycost = MIN(...)): float MIN/MAX
+    # must be the bit-exact f64 stored value, which every f32 device path
+    # (fused / fact-agg / mapped) would round — stay on the host
+    from ballista_tpu.physical.aggregate import needs_exact_float_minmax
+
+    if needs_exact_float_minmax(exec_node):
+        return None
     stable = exec_node.display_indent() + "|" + ",".join(parts) + "|" + flags
     key = stable + "|" + ",".join(mtimes)
     with _stage_cache_lock:

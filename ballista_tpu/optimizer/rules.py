@@ -101,7 +101,10 @@ def push_down_projection(
         ok = _exprs_columns(plan.group_exprs, used)
         ok = _exprs_columns(plan.aggr_exprs, used) and ok
         child = push_down_projection(plan.input, used if ok else None)
-        return lp.Aggregate(child, plan.group_exprs, plan.aggr_exprs)
+        return lp.Aggregate(
+            child, plan.group_exprs, plan.aggr_exprs,
+            exact_floats=getattr(plan, "exact_floats", False),
+        )
 
     if isinstance(plan, lp.Sort):
         used = set(required) if required is not None else None

@@ -268,6 +268,12 @@ class SpmdAggregateExec(ExecutionPlan):
         from ballista_tpu.ops.runtime import UnsupportedOnDevice, bucket_rows
         from ballista_tpu.ops.stage import FusedAggregateStage, MAX_GROUPS
 
+        from ballista_tpu.physical.aggregate import needs_exact_float_minmax
+
+        if needs_exact_float_minmax(self.partial):
+            # q2-shape decorrelated MIN(float): the f32 mesh pmin would be
+            # equality-joined against exact f64 values — host subplan instead
+            raise UnsupportedOnDevice("exact float min/max required")
         if self._stage is None:
             self._stage = FusedAggregateStage(self.partial)
         stage = self._stage

@@ -70,6 +70,16 @@ class AggregateFunc:
         return f"{self.fn.upper()}({self.expr}) AS {self.name}"
 
 
+def needs_exact_float_minmax(agg) -> bool:
+    """True when this aggregate's result is equality-consumed (decorrelated
+    scalar subquery) AND it computes float MIN/MAX — the f32 device paths
+    would round the value so it matches nothing; they must decline."""
+    return getattr(agg, "exact_floats", False) and any(
+        a.fn in ("min", "max") and pa.types.is_floating(a.input_type)
+        for a in agg.aggr_funcs
+    )
+
+
 def _sum_type(dt: pa.DataType) -> pa.DataType:
     if pa.types.is_integer(dt):
         return pa.int64()
@@ -94,11 +104,15 @@ class HashAggregateExec(ExecutionPlan):
         input: ExecutionPlan,
         group_exprs: List[Tuple[PhysicalExpr, str]],
         aggr_funcs: List[AggregateFunc],
+        exact_floats: bool = False,
     ) -> None:
         self.mode = mode
         self.input = input
         self.group_exprs = group_exprs
         self.aggr_funcs = aggr_funcs
+        # float MIN/MAX results are equality-consumed (decorrelated scalar
+        # subquery, q2): the f32 device paths must decline
+        self.exact_floats = exact_floats
         in_schema = input.schema()
 
         group_fields = []
@@ -135,7 +149,10 @@ class HashAggregateExec(ExecutionPlan):
         return [self.input]
 
     def with_children(self, children: List[ExecutionPlan]) -> "HashAggregateExec":
-        return HashAggregateExec(self.mode, children[0], self.group_exprs, self.aggr_funcs)
+        return HashAggregateExec(
+            self.mode, children[0], self.group_exprs, self.aggr_funcs,
+            exact_floats=self.exact_floats,
+        )
 
     # ------------------------------------------------------------------
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:

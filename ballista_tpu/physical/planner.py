@@ -283,6 +283,7 @@ class PhysicalPlanner:
     # ------------------------------------------------------------------
     def _plan_aggregate(self, plan: lp.Aggregate) -> ExecutionPlan:
         input = self._plan(plan.input)
+        exact_floats = getattr(plan, "exact_floats", False)
         in_schema = input.schema()
         group_exprs = [
             (create_physical_expr(e, in_schema), e.output_name())
@@ -326,9 +327,11 @@ class PhysicalPlanner:
             # split stays (streams file-by-file within the HBM budget —
             # how SF=100 fits a 16GB chip).
             merged = input if single_partition else MergeExec(input)
-            return HashAggregateExec(AggregateMode.SINGLE, merged, group_exprs, funcs)
+            return HashAggregateExec(AggregateMode.SINGLE, merged, group_exprs,
+                                     funcs, exact_floats=exact_floats)
 
-        partial = HashAggregateExec(AggregateMode.PARTIAL, input, group_exprs, funcs)
+        partial = HashAggregateExec(AggregateMode.PARTIAL, input, group_exprs,
+                                    funcs, exact_floats=exact_floats)
         if group_exprs:
             # parallel final: hash-exchange partial states on the group keys,
             # then finalize per partition (keys are disjoint across

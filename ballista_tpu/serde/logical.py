@@ -377,6 +377,7 @@ def plan_to_proto(plan: lp.LogicalPlan) -> pb.LogicalPlanNode:
             n.aggregate.group_exprs.append(expr_to_proto(e))
         for e in plan.aggr_exprs:
             n.aggregate.aggr_exprs.append(expr_to_proto(e))
+        n.aggregate.exact_floats = getattr(plan, "exact_floats", False)
     elif isinstance(plan, lp.Sort):
         n.sort.input.CopyFrom(plan_to_proto(plan.input))
         for e in plan.sort_exprs:
@@ -454,6 +455,7 @@ def plan_from_proto(n: pb.LogicalPlanNode) -> lp.LogicalPlan:
             plan_from_proto(n.aggregate.input),
             [expr_from_proto(e) for e in n.aggregate.group_exprs],
             [expr_from_proto(e) for e in n.aggregate.aggr_exprs],
+            exact_floats=n.aggregate.exact_floats,
         )
     if which == "sort":
         return lp.Sort(

@@ -149,6 +149,7 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             n.aggregate.aggr_names.append(a.name)
             n.aggregate.aggr_dtype_ipc.append(dtype_to_ipc(a.dtype))
             n.aggregate.aggr_input_type_ipc.append(dtype_to_ipc(a.input_type))
+        n.aggregate.exact_floats = getattr(plan, "exact_floats", False)
     elif isinstance(plan, HashJoinExec):
         n.join.left.CopyFrom(phys_plan_to_proto(plan.left))
         n.join.right.CopyFrom(phys_plan_to_proto(plan.right))
@@ -315,7 +316,8 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
                 pe = create_physical_expr(expr_from_proto(an.expr), in_schema)
             fn = an.fn if not an.distinct else f"{an.fn}_distinct"
             funcs.append(AggregateFunc(fn, pe, name, dtype, input_type))
-        return HashAggregateExec(mode, input, group_exprs, funcs)
+        return HashAggregateExec(mode, input, group_exprs, funcs,
+                                 exact_floats=n.aggregate.exact_floats)
     if which == "join":
         left = phys_plan_from_proto(n.join.left)
         right = phys_plan_from_proto(n.join.right)

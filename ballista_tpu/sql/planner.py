@@ -1070,7 +1070,10 @@ class SelectPlanner:
 
         if corr_keys:
             group_cols = [i for (_o, i) in corr_keys]
-            agg = lp.Aggregate(inner_plan, group_cols, list(aggs))
+            # exact_floats: the subquery result is compared against source
+            # values (q2: = MIN(ps_supplycost)); f32 device paths decline
+            agg = lp.Aggregate(inner_plan, group_cols, list(aggs),
+                               exact_floats=True)
             mapping = {str(a): lx.Column(a.output_name()) for a in aggs}
             value = rewrite_expr(proj, mapping)
             # project: correlation keys (renamed uniquely) + value
@@ -1097,7 +1100,7 @@ class SelectPlanner:
             return joined, ref
 
         # uncorrelated: single-row aggregate, cross join
-        agg = lp.Aggregate(inner_plan, [], list(aggs))
+        agg = lp.Aggregate(inner_plan, [], list(aggs), exact_floats=True)
         mapping = {str(a): lx.Column(a.output_name()) for a in aggs}
         value = rewrite_expr(proj, mapping)
         agg_proj = lp.Projection(agg, [lx.Alias(value, out_name)])

@@ -4,6 +4,7 @@ integration tests (dev/integration-tests.sh) without containers."""
 
 import logging
 import os
+import pathlib
 
 import pyarrow as pa
 import pytest
@@ -374,8 +375,9 @@ def test_distributed_tpch_with_spmd_fusion(tmp_path):
         )
         register_all(local, str(d))
         tracing.reset()
+        qdir = pathlib.Path(__file__).parent.parent / "benchmarks" / "tpch" / "queries"
         for q in ("q12", "q3"):
-            sql = open(f"benchmarks/tpch/queries/{q}.sql").read()
+            sql = (qdir / f"{q}.sql").read_text()
             got = c.sql(sql).collect().to_pydict()
             want = local.sql(sql).collect().to_pydict()
             assert list(got) == list(want), q

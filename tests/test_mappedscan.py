@@ -301,3 +301,39 @@ def test_multifile_fact_as_build_side(tmp_path):
     np.testing.assert_allclose(
         t.column("s").to_numpy(), c.column("s").to_numpy(), rtol=1e-4
     )
+
+
+def test_float_min_equality_consumer_stays_exact(tmp_path):
+    """TPC-H q2 shape: a decorrelated MIN(float) subquery whose result is
+    equality-joined back against the source column. The device computes
+    f32; the rounded min would match nothing — the rewrite must decline
+    float MIN/MAX so the exact host value flows into the join."""
+    rng = np.random.default_rng(21)
+    n, nk = 8000, 400
+    fact = pa.table(
+        {
+            "fk": pa.array(rng.integers(0, nk, n), type=pa.int64()),
+            # 2-decimal "decimal" values: not exactly representable in f32
+            "cost": pa.array(np.round(rng.uniform(1, 1000, n), 2)),
+        }
+    )
+    dim = pa.table(
+        {
+            "dk": pa.array(np.arange(nk), type=pa.int64()),
+            "attr": pa.array([f"a{i % 9}" for i in range(nk)]),
+        }
+    )
+    paths = {
+        "fact": _write(tmp_path, "fact", fact),
+        "dim": _write(tmp_path, "dim", dim),
+    }
+    sql = (
+        "select fk, cost from dim, fact where dk = fk and cost = ("
+        "  select min(cost) from dim d2, fact f2 "
+        "  where d2.dk = f2.fk and f2.fk = fact.fk"
+        ") order by fk"
+    )
+    t, c = _run_both(paths, sql)
+    assert c.num_rows >= nk  # sanity: the oracle finds every group's min
+    assert t.num_rows == c.num_rows
+    assert t.column("cost").to_pylist() == c.column("cost").to_pylist()
