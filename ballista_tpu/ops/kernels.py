@@ -103,9 +103,12 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     flags = (
         f"fv={ctx.config.tpu_fuse_volatile()},dc={ctx.config.device_cache()},"
         f"sk={ctx.config.tpu_sorted_kernel()},"
-        f"topk={getattr(exec_node, '_topk_pushdown', None)},"
-        f"ef={getattr(exec_node, 'exact_floats', False)}"
+        f"topk={getattr(exec_node, '_topk_pushdown', None)}"
     )
+    # append-only-when-set: ef=False on every key would invalidate every
+    # persisted layout entry written before the flag existed
+    if getattr(exec_node, "exact_floats", False):
+        flags += ",ef=True"
     # decorrelated scalar subqueries equality-compare the aggregate result
     # against source values (q2: ps_supplycost = MIN(...)): float MIN/MAX
     # must be the bit-exact f64 stored value, which every f32 device path
