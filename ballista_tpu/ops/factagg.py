@@ -64,6 +64,7 @@ from ballista_tpu.ops.stage import (
     decode_packed_rows,
     jnp_unpack_i32,
     packed_positions,
+    state_column,
     substitute_columns,
 )
 from ballista_tpu.physical import expr as px
@@ -664,13 +665,7 @@ class FactAggregateStage:
             for _sf in a.state_fields():
                 f = fields[fi]
                 raw = state_rows[ri][:GA][keep]
-                if a.fn in ("min", "max"):
-                    arr = pa.array(raw.astype(np.float64), mask=nonempty == 0)
-                else:
-                    arr = pa.array(raw.astype(np.float64))
-                if arr.type != f.type:
-                    arr = pc.cast(arr, f.type)
-                arrays.append(arr)
+                arrays.append(state_column(a, raw, f.type, nonempty == 0))
                 ri += 1
                 fi += 1
         return pa.table(arrays, schema=self.partial_schema)
@@ -984,13 +979,7 @@ class FactAggregateStage:
             for _ in a.state_fields():
                 f = fields[fi]
                 raw = states[si]
-                if a.fn in ("min", "max"):
-                    arr = pa.array(raw.astype(np.float64), mask=~nonempty)
-                else:
-                    arr = pa.array(raw.astype(np.float64))
-                if arr.type != f.type:
-                    arr = pc.cast(arr, f.type)
-                arrays.append(arr)
+                arrays.append(state_column(a, raw, f.type, ~nonempty))
                 si += 1
                 fi += 1
         return pa.table(arrays, schema=self.partial_schema)

@@ -185,6 +185,24 @@ def substitute_columns(e: px.PhysicalExpr, mapping: List[px.PhysicalExpr]) -> px
     raise UnsupportedOnDevice(f"cannot inline {type(e).__name__}")
 
 
+def state_column(a, raw: np.ndarray, target: pa.DataType,
+                 empty_mask: Optional[np.ndarray]) -> pa.Array:
+    """Cast one decoded aggregate-state row to its partial-schema field.
+    min/max rows null out empty groups (sentinel fills) via empty_mask;
+    date32 states ride as exact int32 day counts (pyarrow has no
+    double->date32 cast). Shared by every device assembly path."""
+    if a.fn in ("min", "max"):
+        if pa.types.is_date32(target):
+            arr = pa.array(raw.astype(np.int32), mask=empty_mask)
+        else:
+            arr = pa.array(raw.astype(np.float64), mask=empty_mask)
+    else:
+        arr = pa.array(raw.astype(np.float64))
+    if arr.type != target:
+        arr = pc.cast(arr, target)
+    return arr
+
+
 def _pack_staged(staged: Dict, arrays: List[np.ndarray]) -> Dict[str, dict]:
     """Append a staged {idx: (tiles, lut, choice)} dict's arrays to the
     persistence list, returning the JSON column manifest. Shared by the
@@ -341,9 +359,15 @@ class FusedAggregateStage:
                 if cv.kind == "code":
                     raise UnsupportedOnDevice("string aggregate input")
                 self.value_fns.append(cv)
+                # dates lower as int32 day counts: exact int min/max (the
+                # f32 route crashed assembling double -> date32, and values
+                # past 2^24 days would round)
                 self.int_exact.append(
                     isinstance(ie, px.ColumnExpr)
-                    and pa.types.is_integer(scan_schema.field(ie.index).type)
+                    and (
+                        pa.types.is_integer(scan_schema.field(ie.index).type)
+                        or pa.types.is_date32(scan_schema.field(ie.index).type)
+                    )
                 )
         self.scan_schema = scan_schema
         self.partial_schema = agg.schema() if agg.mode.value == "partial" else self._partial_schema(agg)
@@ -1311,16 +1335,9 @@ class FusedAggregateStage:
             for _f in a.state_fields():
                 f = fields[col_pos]
                 raw = outputs[oi]
-                if a.fn in ("min", "max"):
-                    # groups with no surviving rows have +/-inf sentinels;
-                    # null them out so the merge ignores them
-                    vals = raw.astype(np.float64)
-                    arr = pa.array(vals, mask=~nonempty)
-                else:
-                    arr = pa.array(raw.astype(np.float64))
-                if arr.type != f.type:
-                    arr = pc.cast(arr, f.type)
-                arrays.append(arr)
+                # groups with no surviving rows carry sentinel fills in
+                # min/max rows; null them out so the merge ignores them
+                arrays.append(state_column(a, raw, f.type, ~nonempty))
                 oi += 1
                 col_pos += 1
         # drop groups where every row was filtered out (counts == 0) to match

@@ -503,3 +503,44 @@ def test_fact_partitions_differ_from_driven_partitions(tmp_path):
     ]
     assert ran, "device fact-agg stage did not run"
     assert any(s.inner.scan_stride is not None for s in ran)
+
+
+def test_date_minmax_through_factagg(tmp_path):
+    """MIN/MAX over a fact-side date32 column through the fact-agg pushdown
+    (the partial assembly crashed casting double -> date32 before the
+    shared state_column helper)."""
+    rng = np.random.default_rng(8)
+    nf, nk = 20_000, 2000
+    fact = pa.table(
+        {
+            "fk": pa.array(rng.integers(0, nk, nf), type=pa.int64()),
+            "amount": pa.array(rng.uniform(1, 100, nf)),
+            "ship": pa.array(
+                rng.integers(8000, 12000, nf), type=pa.int32()
+            ).cast(pa.date32()),
+        }
+    )
+    dim = pa.table(
+        {
+            "dk": pa.array(np.arange(nk), type=pa.int64()),
+            "attr": pa.array([f"a{i % 11}" for i in range(nk)]),
+        }
+    )
+    pq.write_table(fact, str(tmp_path / "fact.parquet"))
+    pq.write_table(dim, str(tmp_path / "dim.parquet"))
+    kernels._stage_cache.clear()
+    res = {}
+    for backend in ("tpu", "cpu"):
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        ctx.register_parquet("fact", str(tmp_path / "fact.parquet"))
+        ctx.register_parquet("dim", str(tmp_path / "dim.parquet"))
+        res[backend] = ctx.sql(
+            "select fk, min(ship) as mn, max(ship) as mx, attr "
+            "from dim, fact where dk = fk group by fk, attr order by fk"
+        ).collect()
+    assert _factagg_stages(), "fact-agg stage not engaged"
+    t, c = res["tpu"], res["cpu"]
+    assert t.column("mn").to_pylist() == c.column("mn").to_pylist()
+    assert t.column("mx").to_pylist() == c.column("mx").to_pylist()
