@@ -66,13 +66,18 @@ _PASSTHROUGH = (FilterExec, ProjectionExec, CoalesceBatchesExec, MergeExec)
 
 
 class Attachment:
-    """One dim subtree joined to the fact on integer key column(s)."""
+    """One subtree joined to the fact on integer key column(s).
+
+    kind "inner": unique-keyed dim whose columns map onto fact rows.
+    kind "semi"/"anti": membership only — no columns attach, no
+    uniqueness requirement (EXISTS / NOT EXISTS semantics; q4's shape)."""
 
     def __init__(self, dim: ExecutionPlan, fact_keys: List[str],
-                 dim_keys: List[str]) -> None:
+                 dim_keys: List[str], kind: str = "inner") -> None:
         self.dim = dim
         self.fact_keys = fact_keys
         self.dim_keys = dim_keys
+        self.kind = kind
 
 
 def _subtree_scan_bytes(node: ExecutionPlan) -> int:
@@ -94,10 +99,19 @@ def _flatten_join_tree(node: ExecutionPlan):
 
     if (
         not isinstance(node, HashJoinExec)
-        or node.join_type != JoinType.INNER
+        or node.join_type not in (JoinType.INNER, JoinType.SEMI, JoinType.ANTI)
         or node.filter is not None
     ):
         return node, []
+    if node.join_type in (JoinType.SEMI, JoinType.ANTI):
+        # semi/anti preserve the LEFT schema: the fact is always the left
+        # side; the right side contributes membership bits only
+        fact, atts = _flatten_join_tree(node.left)
+        kind = "semi" if node.join_type == JoinType.SEMI else "anti"
+        return fact, atts + [
+            Attachment(node.right, [l for l, _ in node.on],
+                       [r for _, r in node.on], kind=kind)
+        ]
     lb = _subtree_scan_bytes(node.left)
     rb = _subtree_scan_bytes(node.right)
     if rb >= lb:
@@ -128,7 +142,8 @@ class MappedScanExec(ExecutionPlan):
         self.attachments = attachments
         fields = list(fact.schema())
         for a in attachments:
-            fields.extend(list(a.dim.schema()))
+            if a.kind == "inner":
+                fields.extend(list(a.dim.schema()))
         fields.append(pa.field("__member", pa.int8()))
         self._schema = pa.schema(fields)
         self._maps: Optional[List[dict]] = None
@@ -145,7 +160,7 @@ class MappedScanExec(ExecutionPlan):
 
     def with_children(self, children: List[ExecutionPlan]) -> "MappedScanExec":
         atts = [
-            Attachment(d, a.fact_keys, a.dim_keys)
+            Attachment(d, a.fact_keys, a.dim_keys, kind=a.kind)
             for d, a in zip(children[1:], self.attachments)
         ]
         return MappedScanExec(children[0], atts)
@@ -168,27 +183,50 @@ class MappedScanExec(ExecutionPlan):
                     raise UnsupportedOnDevice(
                         f"dim map {a.dim_keys} has {table.num_rows} rows"
                     )
-                key_vals = []
                 for k in a.dim_keys:
-                    col = table.column(k)
-                    if not pa.types.is_integer(col.type):
+                    if not pa.types.is_integer(table.column(k).type):
                         raise UnsupportedOnDevice(
                             f"non-integer dim key {k!r}"
                         )
-                    if col.null_count:
-                        raise UnsupportedOnDevice(f"null dim key {k!r}")
-                    key_vals.append(
-                        col.to_numpy(zero_copy_only=False).astype(np.int64)
-                    )
+                if any(table.column(k).null_count for k in a.dim_keys):
+                    # a null key can never match (SQL EXISTS semantics):
+                    # drop rows where ANY key is null — filtering the TABLE
+                    # keeps composite tuples row-aligned AND converts int64
+                    # losslessly (a null-bearing column would round-trip
+                    # through float64, corrupting keys above 2^53). Inner
+                    # dims must decline instead (a mapped row would vanish).
+                    if a.kind == "inner":
+                        raise UnsupportedOnDevice(
+                            f"null dim key in {a.dim_keys}"
+                        )
+                    import pyarrow.compute as pc
+
+                    mask = None
+                    for k in a.dim_keys:
+                        v = pc.is_valid(table.column(k))
+                        mask = v if mask is None else pc.and_(mask, v)
+                    table = table.filter(mask).combine_chunks()
+                key_vals = [
+                    table.column(k).to_numpy(zero_copy_only=False)
+                    .astype(np.int64)
+                    for k in a.dim_keys
+                ]
                 packed, mins, ranges, strides = _pack_dim_keys(key_vals)
-                order = np.argsort(packed, kind="stable")
-                sorted_keys = packed[order]
-                if len(sorted_keys) and np.any(
-                    sorted_keys[1:] == sorted_keys[:-1]
-                ):
-                    raise UnsupportedOnDevice(
-                        f"dim keys {a.dim_keys} not unique (join multiplies)"
-                    )
+                if a.kind == "inner":
+                    order = np.argsort(packed, kind="stable")
+                    sorted_keys = packed[order]
+                    if len(sorted_keys) and np.any(
+                        sorted_keys[1:] == sorted_keys[:-1]
+                    ):
+                        raise UnsupportedOnDevice(
+                            f"dim keys {a.dim_keys} not unique (join multiplies)"
+                        )
+                else:
+                    # membership only: distinct keys suffice, nothing to
+                    # gather — no uniqueness requirement, no retained table
+                    sorted_keys = np.unique(packed)
+                    order = None
+                    table = None
                 maps.append(
                     {
                         "table": table,
@@ -245,7 +283,14 @@ class MappedScanExec(ExecutionPlan):
                 idx = np.searchsorted(m["sorted"], packed)
                 idx_c = np.minimum(idx, len(m["sorted"]) - 1)
                 hit = valid & (m["sorted"][idx_c] == packed)
+            if a.kind == "anti":
+                # NOT EXISTS: keep rows with no match (null keys never
+                # match, so they are kept — SQL NOT EXISTS semantics)
+                member &= ~hit
+                continue
             member &= hit
+            if a.kind == "semi":
+                continue
             # non-member rows gather row 0 (garbage, masked by __member;
             # group codes need non-null values so no null fill here)
             take = m["order"][np.where(hit, idx_c, 0)]

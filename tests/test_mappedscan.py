@@ -337,3 +337,96 @@ def test_float_min_equality_consumer_stays_exact(tmp_path):
     assert c.num_rows >= nk  # sanity: the oracle finds every group's min
     assert t.num_rows == c.num_rows
     assert t.column("cost").to_pylist() == c.column("cost").to_pylist()
+
+
+def test_semi_and_anti_membership(tmp_path):
+    """q4 shape: EXISTS/NOT EXISTS become membership-only attachments —
+    no columns, no uniqueness requirement, null fact keys follow SQL
+    (never match; ANTI keeps them)."""
+    fact = pa.table(
+        {
+            "fk": pa.array([1, 1, 2, 3, None, 5], type=pa.int64()),
+            "mode": pa.array(["a", "b", "a", "b", "a", "b"]),
+            "amount": pa.array([1.0, 2.0, 4.0, 8.0, 16.0, 32.0]),
+        }
+    )
+    # duplicate + null keys on the membership side are fine
+    sub = pa.table(
+        {
+            "sk": pa.array([1, 1, 3, None], type=pa.int64()),
+            "x": pa.array([0.0, 1.0, 2.0, 3.0]),
+        }
+    )
+    paths = {
+        "fact": _write(tmp_path, "fact", fact),
+        "sub": _write(tmp_path, "sub", sub),
+    }
+    for op, expected_s in (
+        ("in", [1.0 + 2.0 + 8.0]),        # fk in (1, 3)
+        ("not in", [4.0 + 32.0]),          # fk = 2, 5 (null fk never matches
+                                           # EXISTS; NOT EXISTS keeps it —
+                                           # but SQL [NOT] IN via EXISTS
+                                           # decorrelation keeps nulls out)
+    ):
+        sql = (
+            "select sum(amount) as s from fact "
+            f"where fk {op} (select sk from sub where sk is not null)"
+        )
+        t, c = _run_both(paths, sql)
+        assert c.column("s").to_pylist() == expected_s, op  # hand oracle
+        assert t.column("s").to_pylist() == c.column("s").to_pylist(), op
+
+
+def test_tpch_q4_device_path(tmp_path):
+    from benchmarks.tpch.datagen import generate, register_all
+
+    d = tmp_path / "tpch"
+    generate(str(d), sf=0.02, parts=1)
+    res = {}
+    for backend in ("tpu", "cpu"):
+        kernels._stage_cache.clear()
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        register_all(ctx, str(d))
+        res[backend] = ctx.sql(
+            open("benchmarks/tpch/queries/q4.sql").read()
+        ).collect()
+        if backend == "tpu":
+            assert _mapped_stages(), "q4 did not engage the mapped path"
+    t, c = res["tpu"], res["cpu"]
+    assert t.column("o_orderpriority").to_pylist() == \
+        c.column("o_orderpriority").to_pylist()
+    assert t.column("order_count").to_pylist() == \
+        c.column("order_count").to_pylist()
+
+
+def test_composite_semi_keys_with_nulls(tmp_path):
+    """Composite EXISTS keys whose dim side has nulls in DIFFERENT rows
+    with equal per-column null counts: tuples must stay row-aligned (a
+    per-column drop_null zipped phantom tuples)."""
+    fact = pa.table(
+        {
+            "k1": pa.array([1, 3, 7], type=pa.int64()),
+            "k2": pa.array([10, 20, 30], type=pa.int64()),
+            "amount": pa.array([1.0, 2.0, 4.0]),
+        }
+    )
+    sub = pa.table(
+        {
+            "s1": pa.array([1, None, 3], type=pa.int64()),
+            "s2": pa.array([10, 20, None], type=pa.int64()),
+        }
+    )
+    paths = {
+        "fact": _write(tmp_path, "fact", fact),
+        "sub": _write(tmp_path, "sub", sub),
+    }
+    # only (1, 10) is a fully-valid dim tuple -> only amount=1.0 survives
+    sql = (
+        "select sum(amount) as s from fact where exists ("
+        "  select 1 from sub where s1 = k1 and s2 = k2)"
+    )
+    t, c = _run_both(paths, sql)
+    assert c.column("s").to_pylist() == [1.0]
+    assert t.column("s").to_pylist() == [1.0]
