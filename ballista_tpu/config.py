@@ -60,6 +60,18 @@ BALLISTA_TPU_SORTED_KERNEL = "ballista.tpu.sorted_kernel"
 # file-backed stages. "" disables; entries keyed by plan + file mtimes
 BALLISTA_TPU_LAYOUT_CACHE_DIR = "ballista.tpu.layout_cache_dir"
 BALLISTA_TPU_LAYOUT_CACHE_CAP = "ballista.tpu.layout_cache_cap_bytes"
+# pipelined host->device ingestion (ops/stage.py, distributed/stages.py):
+# worker threads for the prefetch stage (parquet read + dictionary decode +
+# group ranking, and parallel shuffle-piece fetches). 0 = fully serial
+# (the pre-pipeline path); the encode/upload consume stage stays ordered
+# regardless, so results are bit-identical at any worker count.
+BALLISTA_TPU_INGEST_WORKERS = "ballista.tpu.ingest_workers"
+# max prefetched items in flight beyond the one being consumed, per
+# pipeline stage. The file-read stage (whole decoded tables) and the
+# prepare pipeline (ranked batches) each hold up to `depth` items, and the
+# shuffle reader up to `depth` materialized pieces — so the worst-case
+# host RSS bound is ~2*depth decoded tables, not depth batches
+BALLISTA_TPU_INGEST_DEPTH = "ballista.tpu.ingest_depth"
 # comma-separated directory allowlist for scan paths in plans arriving over
 # the wire ("" = unrestricted, the standalone/local default). The reference
 # executes any deserialized plan (rust/executor/src/flight_service.rs:90-192);
@@ -96,6 +108,8 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     # absolute path for daemons with volatile cwds, "" disables persistence
     BALLISTA_TPU_LAYOUT_CACHE_DIR: ".ballista_cache/layouts",
     BALLISTA_TPU_LAYOUT_CACHE_CAP: str(48 << 30),
+    BALLISTA_TPU_INGEST_WORKERS: "2",
+    BALLISTA_TPU_INGEST_DEPTH: "2",
     BALLISTA_DATA_ROOTS: "",
 }
 
@@ -188,6 +202,14 @@ class BallistaConfig(Mapping[str, str]):
 
     def tpu_hbm_budget(self) -> int:
         return int(self._settings[BALLISTA_TPU_HBM_BUDGET])
+
+    def tpu_ingest_workers(self) -> int:
+        """Prefetch-stage worker threads; 0 = serial ingest (no threads)."""
+        return max(0, int(self._settings[BALLISTA_TPU_INGEST_WORKERS]))
+
+    def tpu_ingest_depth(self) -> int:
+        """Bound on prefetched items in flight (host-RSS cap)."""
+        return max(1, int(self._settings[BALLISTA_TPU_INGEST_DEPTH]))
 
     def data_roots(self):
         """Directory allowlist for wire-plan scan paths; [] = unrestricted."""

@@ -225,8 +225,30 @@ class ShuffleReaderExec(ExecutionPlan):
             loc = self.locations[partition]
             yield from self._read_piece(loc, 0, ctx)
             return
-        for loc in self.locations:
-            yield from self._read_piece(loc, partition, ctx)
+        workers = ctx.config.tpu_ingest_workers()
+        if workers <= 0 or len(self.locations) <= 1:
+            for loc in self.locations:
+                yield from self._read_piece(loc, partition, ctx)
+            return
+        # per-location fetches are independent (local disk read or a Flight
+        # round-trip to the owning executor, each with its own client):
+        # fetch up to `workers` pieces concurrently so reduce stages overlap
+        # network with decode, but yield pieces in location order — batch
+        # order must match the serial loop exactly. Tradeoff vs the serial
+        # loop: overlapping requires buffering, so up to ingest_depth + 1
+        # WHOLE pieces are resident at once (a piece is one map task's
+        # output for this reduce partition, i.e. ~1/num_partitions of a map
+        # task) where the serial path streams batch-by-batch; set
+        # ingest_workers=0 to restore the streaming read if pieces are huge.
+        from ballista_tpu.ops.runtime import ordered_map
+
+        def fetch(loc: ShuffleLocation) -> List[pa.RecordBatch]:
+            return list(self._read_piece(loc, partition, ctx))
+
+        for piece_batches in ordered_map(
+            fetch, self.locations, workers, ctx.config.tpu_ingest_depth()
+        ):
+            yield from piece_batches
 
     def _read_piece(
         self, loc: ShuffleLocation, piece_idx: int, ctx: TaskContext

@@ -82,6 +82,12 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     parts = []
     mtimes = []
     pinned = []
+    # persisted-layout eligibility: every leaf's data identity must be a
+    # file set with covering mtimes. A shuffle-reader-fed (or otherwise
+    # non-file) leaf contributes nothing to the mtime component, so the key
+    # would stay constant across data changes and the layout cache could
+    # return stale tiles — those stages must never persist.
+    file_backed = True
     for leaf in leaves(exec_node):
         if isinstance(leaf, MemoryScanExec):
             parts.append(str(id(leaf.source)))
@@ -92,10 +98,14 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
             # in a separate key component so the superseded entry can be
             # found and its HBM reservations released
             parts.extend(leaf.source.files)
-            mtimes.extend(
-                str(os.path.getmtime(f) if os.path.exists(f) else 0)
-                for f in leaf.source.files
-            )
+            for f in leaf.source.files:
+                if os.path.exists(f):
+                    mtimes.append(str(os.path.getmtime(f)))
+                else:
+                    mtimes.append("0")
+                    file_backed = False  # mtime does not cover this leaf
+        else:
+            file_backed = False
     # config flags participate in the key: a run-time decline under one
     # config must not pin the device path off for another (ADVICE r1). The
     # top-k annotation does too — it changes what a fact-agg stage returns,
@@ -157,10 +167,11 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
                 built = FusedAggregateStage(exec_node)
         except UnsupportedOnDevice:
             built = False
-        # persisted-layout eligibility: only file-backed stages (memory-scan
-        # keys embed id(), which another process could recycle for different
-        # data — a false disk hit would be silent corruption)
-        if built is not False and not pinned:
+        # persisted-layout eligibility: only fully file-backed stages
+        # (memory-scan keys embed id(), which another process could recycle
+        # for different data, and shuffle-fed stages carry no mtimes at all
+        # — a false disk hit either way would be silent corruption)
+        if built is not False and not pinned and file_backed:
             built.persist_key = key
             inner = getattr(built, "inner", None)
             if inner is not None:

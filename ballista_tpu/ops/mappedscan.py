@@ -183,6 +183,13 @@ class MappedScanExec(ExecutionPlan):
                     raise UnsupportedOnDevice(
                         f"dim map {a.dim_keys} has {table.num_rows} rows"
                     )
+                if a.kind == "inner" and table.num_rows == 0:
+                    # an empty inner dim means zero joined rows; _extend's
+                    # gather through an empty order array would IndexError —
+                    # decline and let the host path produce the empty result
+                    raise UnsupportedOnDevice(
+                        f"inner dim map {a.dim_keys} has zero rows"
+                    )
                 for k in a.dim_keys:
                     if not pa.types.is_integer(table.column(k).type):
                         raise UnsupportedOnDevice(

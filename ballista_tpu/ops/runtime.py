@@ -495,3 +495,167 @@ def pad_to(arr: np.ndarray, n: int, fill=0) -> np.ndarray:
         return arr
     pad = np.full(n - len(arr), fill, dtype=arr.dtype)
     return np.concatenate([arr, pad])
+
+
+# -- pipelined ingestion ----------------------------------------------------
+# The per-partition hot path used to be one serial thread: at SF=100 the
+# host-side parquet decode costs ~400 s while the device aggregate takes
+# ~100 ms, so the chip idled >99% of first-touch wall-clock. These two
+# helpers are the bounded producer/consumer shapes the ingest pipeline is
+# built from (ops/stage.py scan/decode vs encode/upload; distributed
+# shuffle-piece fetches). Both preserve input order exactly — the consume
+# side of a stage prepare MUST stay ordered because each batch's narrow
+# choice feeds the next batch's narrow_column prior — and both bound the
+# number of results in flight so host RSS stays ~depth decoded items.
+
+
+def ordered_map(fn, items, workers: int, depth: int = 2):
+    """Concurrent map over a finite, independent item list, yielding
+    results in input order with at most `depth` in flight — depth is the
+    host-RSS cap and wins over workers (extra threads beyond it idle).
+    workers <= 0 (or a single item) degenerates to the serial loop."""
+    items = list(items)
+    if workers <= 0 or len(items) <= 1:
+        for it in items:
+            yield fn(it)
+        return
+    import collections
+    from concurrent.futures import ThreadPoolExecutor
+
+    inflight = max(1, depth)
+    ex = ThreadPoolExecutor(max_workers=workers)
+    pending: collections.deque = collections.deque()
+    i = 0
+    try:
+        while pending or i < len(items):
+            while i < len(items) and len(pending) < inflight:
+                pending.append(ex.submit(fn, items[i]))
+                i += 1
+            yield pending.popleft().result()
+    finally:
+        for f in pending:
+            f.cancel()
+        ex.shutdown(wait=True)
+
+
+def pipelined_map(src, fn, workers: int, depth: int = 2, on_src_time=None):
+    """Ordered streaming producer/consumer over an iterator.
+
+    A reader thread pulls items from `src` serially (the pull itself may be
+    expensive IO — e.g. a parquet read inside a generator), submits
+    fn(item) to a `workers`-thread pool, and the caller consumes results in
+    input order. At most `depth` results exist beyond the one being
+    consumed. Exceptions from `src` or `fn` re-raise at the consumption
+    point in order, so decline signals (UnsupportedOnDevice, TooManyGroups)
+    keep their serial-path semantics. `on_src_time(seconds)` is called from
+    the reader thread with each pull's duration (ingest scan timing).
+
+    workers <= 0 degenerates to the serial in-thread map."""
+    if workers <= 0:
+        it = iter(src)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            if on_src_time is not None:
+                on_src_time(time.perf_counter() - t0)
+            yield fn(item)
+    import queue as _queue
+    from concurrent.futures import ThreadPoolExecutor
+
+    done = object()
+    stop = threading.Event()
+    slots = threading.Semaphore(max(1, depth))
+    out_q: "_queue.Queue" = _queue.Queue()
+    ex = ThreadPoolExecutor(max_workers=workers)
+
+    def _reader() -> None:
+        it = iter(src)
+        while not stop.is_set():
+            # bounded wait so a consumer that stopped early (exception,
+            # generator close) can never strand this thread on the semaphore
+            if not slots.acquire(timeout=0.05):
+                continue
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                slots.release()
+                break
+            except BaseException as e:  # src failure surfaces in order
+                slots.release()
+                out_q.put(("err", e))
+                return
+            if on_src_time is not None:
+                on_src_time(time.perf_counter() - t0)
+            try:
+                out_q.put(("fut", ex.submit(fn, item)))
+            except RuntimeError:
+                # consumer exited early and its finally shut the pool down
+                # while we were blocked in a long pull — nobody is reading
+                # out_q anymore, just exit quietly
+                return
+        out_q.put(done)
+
+    reader = threading.Thread(target=_reader, name="ingest-reader", daemon=True)
+    reader.start()
+    try:
+        while True:
+            msg = out_q.get()
+            if msg is done:
+                break
+            tag, val = msg
+            if tag == "err":
+                raise val
+            yield val.result()
+            slots.release()
+    finally:
+        stop.set()
+        # on normal completion the reader has already exited and the pool is
+        # drained, so these return immediately. On early consumer exit (a
+        # TooManyGroups retry, an exception) do NOT block behind a multi-
+        # second in-flight parquet pull or ranking task: the reader is a
+        # daemon guarded against post-shutdown submits, in-flight fn work is
+        # pure per-batch compute, and the caller (e.g. the sorted-layout
+        # retry) should not stall on work it is about to throw away.
+        reader.join(timeout=0.2)
+        ex.shutdown(wait=False)
+
+
+# accumulated ingest timings across stage prepares (bench.py reports them):
+# scan_s = prefetch-stage work (parquet read + dictionary decode + group
+# ranking), encode_s = host narrow/encode, upload_s = h2d transfer, wall_s =
+# end-to-end prepare. overlap_frac = 1 - wall / (scan + encode + upload):
+# 0 on the serial path, > 0 when the pipeline actually hid host work.
+_ingest_lock = threading.Lock()
+_ingest_totals = {
+    "scan_s": 0.0, "encode_s": 0.0, "upload_s": 0.0, "wall_s": 0.0,
+    "prepares": 0,
+}
+
+
+def record_ingest(scan_s: float, encode_s: float, upload_s: float,
+                  wall_s: float) -> None:
+    with _ingest_lock:
+        _ingest_totals["scan_s"] += scan_s
+        _ingest_totals["encode_s"] += encode_s
+        _ingest_totals["upload_s"] += upload_s
+        _ingest_totals["wall_s"] += wall_s
+        _ingest_totals["prepares"] += 1
+
+
+def ingest_stats(reset: bool = False) -> Dict[str, float]:
+    """Snapshot of accumulated ingest timings plus the derived overlap
+    fraction."""
+    with _ingest_lock:
+        out = dict(_ingest_totals)
+        if reset:
+            for k in _ingest_totals:
+                _ingest_totals[k] = 0.0 if k != "prepares" else 0
+    stages = out["scan_s"] + out["encode_s"] + out["upload_s"]
+    out["overlap_frac"] = (
+        max(0.0, 1.0 - out["wall_s"] / stages) if stages > 0 else 0.0
+    )
+    return out

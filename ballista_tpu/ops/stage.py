@@ -653,18 +653,29 @@ class FusedAggregateStage:
         if isinstance(self.scan, ParquetScanExec):
             import pyarrow.parquet as pq
 
+            from ballista_tpu.ops.runtime import ordered_map
+
             names = self.scan.schema().names
             strings = [
                 f.name
                 for f in self.scan.schema()
                 if pa.types.is_string(f.type) or pa.types.is_large_string(f.type)
             ]
-            for p in parts:
-                table = pq.read_table(
+
+            def read_one(p: int) -> pa.Table:
+                return pq.read_table(
                     self.scan.source.files[p],
                     columns=names,
                     read_dictionary=strings,
                 ).combine_chunks()
+
+            # multi-file (scan_stride) reads are independent: decode up to
+            # `workers` files concurrently, yielding tables in file order so
+            # the batch stream is identical to the serial read
+            for table in ordered_map(
+                read_one, parts,
+                ctx.config.tpu_ingest_workers(), ctx.config.tpu_ingest_depth(),
+            ):
                 yield from table.to_batches(max_chunksize=ctx.batch_size)
             return
         for p in parts:
@@ -705,14 +716,31 @@ class FusedAggregateStage:
         Like the sorted path, the staged host artifacts persist through
         ops/layout_cache.py: the low-cardinality shapes (q1/q6) pay the
         same full-scan decode at SF=100 (~400 s measured), so a fresh
-        process must skip straight to the h2d transfer too. Uploads stay
-        IN-LOOP: each batch's narrow choice must feed the next batch's
-        narrow_column prior (one jitted step), and the non-persisting host
-        peak stays one batch's tiles. When persisting, a host snapshot of
-        every batch's tiles is retained until the save at the end — up to
-        the HBM budget of extra host RSS, for that one prepare."""
+        process must skip straight to the h2d transfer too.
+
+        Pipelined (ballista.tpu.ingest_workers > 0): the PREFETCH stage —
+        parquet read + dictionary decode (inside _scan_batches) and group
+        ranking — runs on a small thread pool with at most ingest_depth
+        batches in flight, overlapping the CONSUME stage below. Consume
+        (narrow/encode/upload) stays strictly IN-ORDER and in-thread: each
+        batch's narrow choice must feed the next batch's narrow_column
+        prior (one jitted step), the growing ColumnDictionary must assign
+        codes in batch order (bit-identical results at any worker count),
+        and the non-persisting host peak stays ~depth batches' tiles. When
+        persisting, a host snapshot of every batch's tiles is retained
+        until the save at the end — up to the HBM budget of extra host
+        RSS, for that one prepare."""
+        import time as _time
+
         import jax.numpy as jnp
 
+        from ballista_tpu.ops.runtime import pipelined_map, record_ingest
+
+        t_wall0 = _time.perf_counter()
+        scan_s = 0.0
+        encode_s = 0.0
+        upload_s = 0.0
+        src_times: List[float] = []  # appended by the reader thread only
         persisting = (
             bool(ctx.config.tpu_layout_cache_dir())
             and self.persist_key is not None
@@ -724,20 +752,35 @@ class FusedAggregateStage:
         # than OOM the chip (mirrors the sorted path's staged check)
         budget = ctx.config.tpu_hbm_budget()
         total_bytes = 0
-        for batch in self._scan_batches(partition, ctx):
-            if batch.num_rows == 0:
-                continue
+
+        def _prefetch(batch: pa.RecordBatch):
+            # group codes FIRST: a high-cardinality switch must not pay the
+            # column upload. Pure per-batch work (no shared stage state), so
+            # batches may rank concurrently; the TooManyGroups decision
+            # stays in the ordered consumer below for serial-identical
+            # semantics.
+            t0 = _time.perf_counter()
+            codes, key_values, n_groups = self._group_codes(batch)
+            return batch, codes, key_values, n_groups, _time.perf_counter() - t0
+
+        batch_src = (
+            b for b in self._scan_batches(partition, ctx) if b.num_rows
+        )
+        for batch, codes, key_values, n_groups, dt in pipelined_map(
+            batch_src, _prefetch,
+            ctx.config.tpu_ingest_workers(), ctx.config.tpu_ingest_depth(),
+            on_src_time=src_times.append,
+        ):
+            scan_s += dt
             n = batch.num_rows
             bucket = bucket_rows(n)
-            # group codes FIRST: a high-cardinality switch must not pay the
-            # column upload
-            codes, key_values, n_groups = self._group_codes(batch)
             if n_groups == 0:
                 continue
             if n_groups > MAX_GROUPS:
                 # beyond the unrolled path's ceiling: run() retries with the
                 # sorted chunked-segment layout
                 raise TooManyGroups(f"{n_groups} groups exceeds unrolled path")
+            t_enc0 = _time.perf_counter()
             npcols = self._lower_columns(batch)
             self._check_int_ranges(npcols, n)
             staged: Dict[int, tuple] = {}
@@ -761,6 +804,7 @@ class FusedAggregateStage:
             codes_pad = pad_to(codes.astype(np.int16), bucket, 0)
             row_valid = np.zeros(bucket, dtype=np.bool_)
             row_valid[:n] = True
+            encode_s += _time.perf_counter() - t_enc0
             rec = {
                 "n_groups": int(n_groups),
                 "seg_bucket": int(seg_bucket),
@@ -770,6 +814,7 @@ class FusedAggregateStage:
             }
             if persisting:
                 records.append({**rec, "staged": dict(staged)})
+            t_up0 = _time.perf_counter()
             make_headroom(self, total_bytes, budget)
             cols = _upload_staged(staged, self._narrow_choice)
             entries.append(
@@ -782,8 +827,12 @@ class FusedAggregateStage:
                     "key_values": key_values,
                 }
             )
+            upload_s += _time.perf_counter() - t_up0
         if persisting and records:
             self._save_batches_layout(partition, ctx, records)
+        scan_s += sum(src_times)
+        wall_s = _time.perf_counter() - t_wall0
+        record_ingest(scan_s, encode_s, upload_s, wall_s)
         return entries
 
     def _save_batches_layout(self, partition: int, ctx, records: List[dict]) -> None:
@@ -888,17 +937,25 @@ class FusedAggregateStage:
         ops/layout_cache.py so a fresh process skips straight to the h2d
         transfer (measured: it is ~600 of the 737 s of a cold q3 SF=100).
         The pallas kernel path is not persisted (config-gated, flat layout)."""
+        import time as _time
+
         from ballista_tpu.ops.layout import SortedSegmentLayout
+        from ballista_tpu.ops.runtime import record_ingest
 
         loaded = self._load_layout(partition, ctx, want=("sorted",))
         if loaded is not None:
             return loaded
+        # the prefetch/consume split here is inside _scan_batches: multi-file
+        # partitions decode up to ingest_workers files concurrently; the
+        # whole-partition rank/sort/materialize below is one ordered pass
+        t_wall0 = _time.perf_counter()
         batches = [b for b in self._scan_batches(partition, ctx) if b.num_rows]
         if not batches:
             return {"kind": "empty"}
         table = pa.Table.from_batches(batches).combine_chunks()
         batch = table.to_batches(max_chunksize=table.num_rows)[0]
         codes, key_values, n_groups = self._group_codes(batch)
+        scan_s = _time.perf_counter() - t_wall0
         if n_groups == 0:
             return {"kind": "empty"}
         if (
@@ -966,13 +1023,23 @@ class FusedAggregateStage:
             raise UnsupportedOnDevice(
                 f"stage tiles ({total >> 20} MiB) exceed the HBM budget"
             )
+        t_enc_end = _time.perf_counter()
+        encode_s = t_enc_end - t_wall0 - scan_s
         # persist BEFORE upload: _upload_staged consumes the host tiles
         self._save_sorted_layout(
             partition, ctx, layout, staged, staged_derived, key_values
         )
-        return self._finish_sorted(
+        t_up0 = _time.perf_counter()
+        # the layout-cache disk write is host-side prepare cost: count it in
+        # encode_s so wall_s stays the sum of the components and the derived
+        # overlap fraction is not dragged down on persisting prepares
+        encode_s += t_up0 - t_enc_end
+        out = self._finish_sorted(
             ctx, layout, staged, staged_derived, key_values, total
         )
+        t_end = _time.perf_counter()
+        record_ingest(scan_s, encode_s, t_end - t_up0, t_end - t_wall0)
+        return out
 
     def _finish_sorted(
         self, ctx, layout, staged: Dict, staged_derived: Dict, key_values,

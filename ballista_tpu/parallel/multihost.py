@@ -55,13 +55,18 @@ def allgather_rows(x: np.ndarray) -> np.ndarray:
     import jax
     from jax.experimental import multihost_utils as mhu
 
-    if jax.process_count() == 1:
-        return np.asarray(x)
+    # normalize bool -> int64 up front: every return path (single-process
+    # passthrough, padded gather, empty) must agree on dtype, or one host's
+    # empty-bool input concatenates against another's int64 pad buffer
     x = np.asarray(x)
+    if x.dtype == np.bool_:
+        x = x.astype(np.int64)
+    if jax.process_count() == 1:
+        return x
     lens = mhu.process_allgather(np.array([len(x)], dtype=np.int64))
     lens = np.asarray(lens).reshape(-1)
     pad = int(lens.max()) if len(lens) else 0
-    padded = np.zeros(pad, dtype=x.dtype if x.dtype != np.bool_ else np.int64)
+    padded = np.zeros(pad, dtype=x.dtype)
     padded[: len(x)] = x
     gathered = np.asarray(mhu.process_allgather(padded))
     return np.concatenate(

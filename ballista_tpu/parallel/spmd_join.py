@@ -146,6 +146,7 @@ class SpmdJoinExec(ExecutionPlan):
 
     # ------------------------------------------------------------------
     def _execute_mesh(self, ctx: TaskContext) -> pa.Table:
+        import jax
         import jax.numpy as jnp
 
         from ballista_tpu.ops.runtime import UnsupportedOnDevice
@@ -154,6 +155,14 @@ class SpmdJoinExec(ExecutionPlan):
             take_table,
         )
         from ballista_tpu.physical.joinutil import _refactorize
+
+        if jax.process_count() > 1:
+            # pod runs: collect_all below reads HOST-LOCAL rows, but the
+            # mesh spans every process — shard_map would feed each host's
+            # partial arrays to a global program (wrong results or a hang).
+            # The aggregate path has a multihost protocol; the join does
+            # not yet — decline to the host join.
+            raise UnsupportedOnDevice("mesh join v1 is single-host")
 
         join = self.subplan
         if join.join_type not in (JoinType.INNER, JoinType.LEFT):

@@ -427,7 +427,13 @@ class SpmdAggregateExec(ExecutionPlan):
                     my_distinct[j] = np.concatenate(cols_j[j])
             for d in local.values():
                 d["npcols"] = stage._lower_columns(d["batch"])
-        except UnsupportedOnDevice:
+        except (UnsupportedOnDevice, MemoryError, OSError, pa.ArrowException):
+            # the read/lower fence must catch host-side failures too (a
+            # missing file is OSError, an OOM during decode MemoryError, a
+            # truncated/corrupt parquet ArrowInvalid — which subclasses
+            # ValueError, not OSError): the decline has to be COLLECTIVE,
+            # or the healthy peers block forever in the allgather below
+            # waiting for this host
             ok = False
         if not mh.agree(ok):
             raise UnsupportedOnDevice("multi-host mesh declined collectively")

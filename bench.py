@@ -229,6 +229,28 @@ def _persist_capture(result: dict) -> None:
         print(f"[persist] failed: {e}", file=sys.stderr)
 
 
+def _ingest_snapshot() -> dict | None:
+    """Drain the ingest-timing accumulator (ops/runtime.py): scan/encode/
+    upload seconds and the overlap fraction of the stage prepares since the
+    last drain. None when no fresh prepare ran (fully cached)."""
+    try:
+        from ballista_tpu.ops.runtime import ingest_stats
+
+        s = ingest_stats(reset=True)
+    except Exception:
+        return None
+    if not s.get("prepares"):
+        return None
+    return {
+        "prepares": s["prepares"],
+        "scan_s": round(s["scan_s"], 3),
+        "encode_s": round(s["encode_s"], 3),
+        "upload_s": round(s["upload_s"], 3),
+        "wall_s": round(s["wall_s"], 3),
+        "overlap_frac": round(s["overlap_frac"], 3),
+    }
+
+
 def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
     try:
         sql = (QUERIES_DIR / f"{name}.sql").read_text()
@@ -240,7 +262,9 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
                   file=sys.stderr)
             return None
         ensure_data(sf)
+        _ingest_snapshot()  # drain: attribute prepares to THIS config
         run_once("tpu", sql, sf)  # warmup: compile + caches
+        ingest = _ingest_snapshot()  # fresh prepares happen at warmup
         t = min(run_once("tpu", sql, sf) for _ in range(iters))
         run_once("cpu", sql, sf)
         c = min(run_once("cpu", sql, sf) for _ in range(iters))
@@ -254,6 +278,12 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         "cpu_ms": round(c * 1000, 1),
         "speedup": round(c / t, 2),
     }
+    if ingest is not None:
+        row["ingest"] = ingest
+        print(f"[ingest] {name} sf={sf}: scan={ingest['scan_s']}s "
+              f"encode={ingest['encode_s']}s upload={ingest['upload_s']}s "
+              f"wall={ingest['wall_s']}s overlap={ingest['overlap_frac']}",
+              file=sys.stderr)
     print(f"[config] {name} sf={sf}: tpu={row['tpu_ms']}ms "
           f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x", file=sys.stderr)
     return row
@@ -310,7 +340,9 @@ def main() -> None:
     # headline: q1 at BENCH_SF — warmup (compile + caches) then best-of-3
     # steady state, both backends
     q1 = (QUERIES_DIR / "q1.sql").read_text()
+    _ingest_snapshot()  # drain
     run_once("tpu", q1)
+    headline_ingest = _ingest_snapshot()
     tpu_dt = min(run_once("tpu", q1) for _ in range(3))
     run_once("cpu", q1)
     cpu_dt = min(run_once("cpu", q1) for _ in range(3))
@@ -353,6 +385,8 @@ def main() -> None:
         "vs_baseline": round(value / baseline, 3),
         "configs": configs,
     }
+    if headline_ingest is not None:
+        result["ingest"] = headline_ingest
     try:
         import jax
 

@@ -1,0 +1,326 @@
+"""Regression tests for the round-5 advisor findings (ADVICE r5): zero-row
+inner attachments, persisted-layout eligibility for non-file-backed stages,
+the multi-host read/lower fence, the pod guard on the mesh join, and bool
+allgather normalization."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.ops import kernels
+from ballista_tpu.ops.runtime import UnsupportedOnDevice
+from ballista_tpu.physical.plan import TaskContext
+
+
+def _reset_stage_caches():
+    from ballista_tpu.ops.runtime import release_stage_residency, reset_residency
+
+    for stage in kernels._stage_cache.values():
+        if stage not in (None, False):
+            release_stage_residency(stage)
+    kernels._stage_cache.clear()
+    kernels._stage_cache_pins.clear()
+    kernels._stage_latest.clear()
+    reset_residency()
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    _reset_stage_caches()
+    yield
+    _reset_stage_caches()
+
+
+# -- ops/mappedscan.py: zero-row INNER attachment ---------------------------
+
+
+def test_zero_row_inner_attachment_declines_to_host(tmp_path):
+    """An empty inner dim must decline (UnsupportedOnDevice), not IndexError
+    through _extend's empty gather; the host path returns the correct empty
+    result. The dim-valued aggregate input keeps factagg out of the way, so
+    the mapped rewrite owns this shape."""
+    n = 3000
+    fact = pa.table(
+        {
+            "fk": pa.array(np.arange(n) % 50, type=pa.int64()),
+            "mode": pa.array([f"m{i % 4}" for i in range(n)]),
+            "amount": pa.array(np.linspace(0.0, 1.0, n)),
+        }
+    )
+    dim = pa.table(
+        {
+            "dk": pa.array([], type=pa.int64()),
+            "prio": pa.array([], type=pa.string()),
+        }
+    )
+    fp, dp = str(tmp_path / "fact.parquet"), str(tmp_path / "dim.parquet")
+    pq.write_table(fact, fp)
+    pq.write_table(dim, dp)
+    sql = (
+        "select mode, sum(case when prio = 'p0' then 1 else 0 end) as c0, "
+        "sum(amount) as s from dim, fact where dk = fk group by mode"
+    )
+    outs = {}
+    for backend in ("cpu", "tpu"):
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        ctx.register_parquet("fact", fp)
+        ctx.register_parquet("dim", dp)
+        outs[backend] = ctx.sql(sql).collect()
+    assert outs["cpu"].num_rows == 0
+    assert outs["tpu"].num_rows == 0
+    assert outs["tpu"].schema == outs["cpu"].schema
+
+
+# -- ops/kernels.py: persisted-layout eligibility ---------------------------
+
+
+def _shuffle_fed_aggregate(tmp_path, schema):
+    """A PARTIAL aggregate whose leaf is a ShuffleReaderExec over one local
+    piece — a stage whose data identity is NOT file-backed."""
+    from ballista_tpu.distributed.stages import ShuffleLocation, ShuffleReaderExec
+    from ballista_tpu.physical import expr as px
+    from ballista_tpu.physical.aggregate import (
+        AggregateFunc,
+        AggregateMode,
+        HashAggregateExec,
+    )
+
+    base = tmp_path / "map0"
+    base.mkdir(parents=True, exist_ok=True)
+    piece = base / "0.arrow"
+    with pa.ipc.new_file(str(piece), schema) as w:
+        w.write_batch(
+            pa.record_batch(
+                [
+                    pa.array([1, 1, 2, 2], type=pa.int64()),
+                    pa.array([1.0, 2.0, 3.0, 4.0]),
+                ],
+                schema=schema,
+            )
+        )
+    reader = ShuffleReaderExec(
+        [ShuffleLocation("e0", "localhost", 50050, str(base))],
+        schema,
+        num_partitions=1,
+    )
+    agg = HashAggregateExec(
+        AggregateMode.PARTIAL,
+        reader,
+        [(px.ColumnExpr("g", 0), "g")],
+        [AggregateFunc("sum", px.ColumnExpr("v", 1), "s", pa.float64(), pa.float64())],
+    )
+    return agg, piece
+
+
+def test_shuffle_fed_stage_never_persists(tmp_path):
+    """A stage fed by a shuffle reader carries no file mtimes in its cache
+    key: persisting its layout could serve stale tiles after the shuffle
+    data changes. persist_key must stay None and no entry may be written."""
+    schema = pa.schema([pa.field("g", pa.int64()), pa.field("v", pa.float64())])
+    agg, _piece = _shuffle_fed_aggregate(tmp_path, schema)
+    cache_dir = tmp_path / "layouts"
+    cfg = BallistaConfig(
+        {
+            "ballista.executor.backend": "tpu",
+            "ballista.tpu.fuse_volatile_sources": "true",
+            "ballista.tpu.layout_cache_dir": str(cache_dir),
+        }
+    )
+    out = kernels.hash_aggregate(agg, 0, TaskContext(config=cfg))
+    assert out is not None and out.num_rows > 0
+    stages = [s for s in kernels._stage_cache.values() if s not in (None, False)]
+    assert stages, "device stage did not build"
+    assert all(s.persist_key is None for s in stages)
+    assert not cache_dir.exists() or not any(cache_dir.rglob("*"))
+
+
+def test_layout_cache_misses_after_file_mtime_change(tmp_path):
+    """File-backed stages DO persist — and a rewritten file (new mtime) must
+    miss the cache and produce the new data's results in a fresh process."""
+    path = str(tmp_path / "t.parquet")
+    cache = str(tmp_path / "layouts")
+
+    def write(mult, when):
+        pq.write_table(
+            pa.table(
+                {
+                    "g": pa.array([1, 1, 2, 2] * 500, type=pa.int64()),
+                    "v": pa.array([float(mult)] * 2000),
+                }
+            ),
+            path,
+        )
+        os.utime(path, (when, when))
+
+    def run():
+        ctx = ExecutionContext(
+            BallistaConfig(
+                {
+                    "ballista.executor.backend": "tpu",
+                    "ballista.tpu.layout_cache_dir": cache,
+                }
+            )
+        )
+        ctx.register_parquet("t", path)
+        out = ctx.sql("select g, sum(v) as s from t group by g").collect()
+        return dict(zip(out.column("g").to_pylist(), out.column("s").to_pylist()))
+
+    t0 = os.stat(tmp_path).st_mtime
+    write(1, t0)
+    assert run() == {1: 1000.0, 2: 1000.0}
+    write(3, t0 + 60)  # rewritten data, strictly newer mtime
+    _reset_stage_caches()  # fresh process: only the DISK cache survives
+    assert run() == {1: 3000.0, 2: 3000.0}
+
+
+# -- parallel/spmd_stage.py: multi-host read/lower fence --------------------
+
+
+def _spmd_aggregate():
+    from ballista_tpu.distributed.planner import DistributedPlanner
+    from ballista_tpu.logical import col, functions as F
+    from ballista_tpu.parallel.spmd_stage import SpmdAggregateExec
+
+    cfg = BallistaConfig(
+        {
+            "ballista.executor.backend": "tpu",
+            "ballista.tpu.spmd_stages": "true",
+            "ballista.tpu.mesh": "data:8",
+        }
+    )
+    ctx = ExecutionContext(cfg)
+    rng = np.random.default_rng(2)
+    ctx.register_record_batches(
+        "t",
+        pa.table(
+            {
+                "g": pa.array(rng.integers(0, 4, 400), type=pa.int64()),
+                "v": pa.array(rng.uniform(0, 1, 400)),
+            }
+        ),
+        n_partitions=4,
+    )
+    df = ctx.table("t").aggregate([col("g")], [F.sum(col("v")).alias("s")])
+    phys = ctx.create_physical_plan(df.logical_plan())
+    stages = DistributedPlanner(cfg).plan_query_stages("job", phys)
+
+    def find(n):
+        if isinstance(n, SpmdAggregateExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    spmd = next((j for j in (find(s) for s in stages) if j is not None), None)
+    assert spmd is not None, "planner did not fuse the aggregate"
+    return spmd, cfg
+
+
+@pytest.mark.parametrize(
+    "exc",
+    [
+        OSError("parquet file vanished mid-read"),
+        MemoryError("decode OOM"),
+        pa.ArrowInvalid("Parquet magic bytes not found"),
+    ],
+    ids=["oserror", "memoryerror", "arrowinvalid"],
+)
+def test_multihost_fence_declines_host_failures_collectively(monkeypatch, exc):
+    """A host-side failure during this host's reads (missing file, decode
+    OOM, corrupt parquet — ArrowInvalid subclasses ValueError, not OSError)
+    must flow into the COLLECTIVE agree(False) decline — not escape the
+    fence and leave peers blocked in the allgather."""
+    from ballista_tpu.ops.stage import FusedAggregateStage
+    from ballista_tpu.parallel import multihost as mh
+
+    spmd, cfg = _spmd_aggregate()
+    tctx = TaskContext(config=cfg)
+    stage = FusedAggregateStage(spmd.partial)
+    mesh = spmd._build_mesh(tctx)
+    n_dev = int(np.prod(list(mesh.shape.values())))
+
+    agreed = []
+
+    def fake_agree(ok):
+        agreed.append(ok)
+        return ok
+
+    def boom(n_parts, mesh):
+        raise exc
+
+    monkeypatch.setattr(mh, "agree", fake_agree)
+    monkeypatch.setattr(mh, "owned_partitions", boom)
+    with pytest.raises(UnsupportedOnDevice, match="declined collectively"):
+        spmd._execute_mesh_multihost(tctx, stage, mesh, n_dev)
+    assert agreed == [False]
+
+
+# -- parallel/spmd_join.py: pod guard ---------------------------------------
+
+
+def test_mesh_join_declines_on_multi_process(monkeypatch):
+    """collect_all reads host-LOCAL rows; on a pod the mesh spans every
+    process, so feeding those arrays to a global shard_map is wrong — the
+    mesh join must decline to the host join when process_count > 1."""
+    import jax
+
+    from ballista_tpu.distributed.planner import DistributedPlanner
+    from ballista_tpu.parallel.spmd_join import SpmdJoinExec
+
+    cfg = BallistaConfig(
+        {
+            "ballista.executor.backend": "tpu",
+            "ballista.tpu.spmd_stages": "true",
+            "ballista.tpu.mesh": "data:8",
+        }
+    )
+    ctx = ExecutionContext(cfg)
+    ctx.register_record_batches(
+        "l",
+        pa.table({"dk": pa.array(range(50), type=pa.int64())}),
+        n_partitions=2,
+    )
+    ctx.register_record_batches(
+        "r",
+        pa.table({"fk": pa.array([i % 50 for i in range(200)], type=pa.int64()),
+                  "v": pa.array(np.arange(200, dtype=np.float64))}),
+        n_partitions=2,
+    )
+    df = ctx.table("l").join(ctx.table("r"), ["dk"], ["fk"], how="inner")
+    phys = ctx.create_physical_plan(df.logical_plan())
+    stages = DistributedPlanner(cfg).plan_query_stages("job", phys)
+
+    def find(n):
+        if isinstance(n, SpmdJoinExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    spmd = next((j for j in (find(s) for s in stages) if j is not None), None)
+    assert spmd is not None, "planner did not fuse the join"
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with pytest.raises(UnsupportedOnDevice, match="single-host"):
+        spmd._execute_mesh(TaskContext(config=cfg, work_dir="/tmp", job_id="t"))
+
+
+# -- parallel/multihost.py: bool allgather ----------------------------------
+
+
+def test_allgather_rows_normalizes_bool_to_int64():
+    from ballista_tpu.parallel import multihost as mh
+
+    out = mh.allgather_rows(np.array([True, False, True]))
+    assert out.dtype == np.int64
+    assert out.tolist() == [1, 0, 1]
