@@ -11,7 +11,11 @@ from __future__ import annotations
 
 import argparse
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same API under the PyPI name
+    import tomli as tomllib
 from typing import Any, Dict, List, Optional, Tuple
 
 SCHEDULER_SPEC: List[Tuple[str, Any, str]] = [

@@ -56,6 +56,7 @@ from ballista_tpu.ops.runtime import (
     UnsupportedOnDevice,
     bucket_rows,
     pad_to,
+    record_readback,
     widen_cols,
 )
 from ballista_tpu.ops.stage import (
@@ -129,6 +130,16 @@ class FactAggregateStage:
 
     @staticmethod
     def try_build(agg) -> Optional["FactAggregateStage"]:
+        from ballista_tpu.physical.aggregate import needs_exact_float_minmax
+
+        if needs_exact_float_minmax(agg):
+            # equality-consumed float MIN/MAX (q2): the fact-agg inner runs
+            # with float_bits=False (its per-field row math can't carry the
+            # two-row f64 key planes), so its f32 min/max would round the
+            # result to match nothing. Step aside: the mapped-scan rewrite
+            # below this in the ladder lowers plain-column MIN/MAX through
+            # the order-preserving bijection instead (ops/floatbits.py).
+            return None
         try:
             return FactAggregateStage(agg)
         except UnsupportedOnDevice:
@@ -337,7 +348,11 @@ class FactAggregateStage:
             [(px.ColumnExpr(self.fact_key, fact_key_idx), self.fact_key)],
             syn_aggs,
         )
-        self.inner = FusedAggregateStage(syn)
+        # float_bits=False: the fact-agg readback/row math addresses one row
+        # per state FIELD (_score_row, _decode); the bijected f64 min/max
+        # states occupy two key-plane rows, which this path cannot carry.
+        # Float min/max here keeps the documented f32 semantics.
+        self.inner = FusedAggregateStage(syn, float_bits=False)
         # chunk partials must BE group partials (member mask / top-k index
         # group space); widen L1 to the longest key run
         self.inner.sorted_cover_max = True
@@ -644,6 +659,7 @@ class FactAggregateStage:
                 jnp.asarray(p_rank), jnp.asarray(allowed_pad),
             )
         )
+        record_readback(packed.shape[-1], packed.nbytes)
         rows = self._decode(packed)
         counts = rows[0][:GA]
         keep = counts > 0
@@ -877,6 +893,7 @@ class FactAggregateStage:
                 self._fact_step(ent["layout"].L1, ent["cols"], aux,
                                 ent["clen"], jnp.asarray(bits))
             )
+            record_readback(packed.shape[-1], packed.nbytes)
             sel, scores, valid = packed[:-4], packed[-4], packed[-1] > 0
             idx = (
                 packed[-3].astype(np.int64) * 65536
@@ -932,6 +949,7 @@ class FactAggregateStage:
             self._fact_step(ent["layout"].L1, ent["cols"], aux, ent["clen"],
                             jnp.asarray(pos_pad))
         )[:, :n_pos]
+        record_readback(sel.shape[-1], sel.nbytes)
         rows = self._decode(sel)
         keep = rows[0] > 0
         return self._assemble_decoded(

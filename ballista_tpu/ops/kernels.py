@@ -121,12 +121,12 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         flags += ",ef=True"
     # decorrelated scalar subqueries equality-compare the aggregate result
     # against source values (q2: ps_supplycost = MIN(...)): float MIN/MAX
-    # must be the bit-exact f64 stored value, which every f32 device path
-    # (fused / fact-agg / mapped) would round — stay on the host
-    from ballista_tpu.physical.aggregate import needs_exact_float_minmax
-
-    if needs_exact_float_minmax(exec_node):
-        return None
+    # must be the bit-exact stored value. The fused stage delivers exactly
+    # that for plain columns via the order-preserving IEEE-754<->int
+    # bijection (ops/floatbits.py) — integer min/max on device, inverted on
+    # readback, zero rounding — so the ladder runs; the paths that cannot
+    # be exact decline individually (factagg.try_build steps aside, the
+    # fused stage rejects exact min/max over computed expressions).
     stable = exec_node.display_indent() + "|" + ",".join(parts) + "|" + flags
     key = stable + "|" + ",".join(mtimes)
     with _stage_cache_lock:
@@ -152,14 +152,34 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         try:
             from ballista_tpu.ops.factagg import FactAggregateStage
 
+            from ballista_tpu.ops.mappedscan import try_rewrite_mapped
+
             # aggregate over a join: try the fact-side pushdown first
             built = FactAggregateStage.try_build(exec_node)
+            if (
+                built is not None
+                and getattr(built, "topk", None) is None
+                and getattr(exec_node, "_topk_pushdown", None) is not None
+            ):
+                # factagg admitted the shape but its epilogue cannot fuse
+                # (dim-only grouping, q10: output groups are not fact keys,
+                # so its per-key top-k would rank the wrong thing and the
+                # member-select readback pays O(members) d2h). A mapped
+                # rewrite groups directly by the OUTPUT keys, so the fused
+                # stage's lexicographic top-k applies — prefer it when its
+                # spec is live, keeping the O(limit) readback.
+                rewritten = try_rewrite_mapped(exec_node)
+                if rewritten is not None:
+                    try:
+                        alt = FusedAggregateStage(rewritten)
+                        if alt.topk is not None:
+                            built = alt
+                    except UnsupportedOnDevice:
+                        pass
             if built is None:
                 # shapes factagg excludes (multi-key fact joins, dim-valued
                 # aggregate inputs, fact-column group keys — q7-q9/q12):
                 # rewrite the join tree to a mapped fact scan and fuse that
-                from ballista_tpu.ops.mappedscan import try_rewrite_mapped
-
                 rewritten = try_rewrite_mapped(exec_node)
                 if rewritten is not None:
                     built = FusedAggregateStage(rewritten)

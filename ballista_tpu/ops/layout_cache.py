@@ -39,7 +39,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-_FORMAT = 3  # bump to invalidate all persisted entries
+# bump to invalidate all persisted entries. v4: float-bits key planes join
+# the staged column set and the fused top-k epilogue forces a
+# one-chunk-per-group cover — entries written by v3 lack both.
+_FORMAT = 4
 
 
 def cache_dir_for(base: str, stage_key: str, partition: int) -> str:

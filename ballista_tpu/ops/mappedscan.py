@@ -439,7 +439,8 @@ def try_rewrite_mapped(agg) -> Optional[object]:
         for a in agg.aggr_funcs
     ]
     try:
-        out = HashAggregateExec(agg.mode, cur, group_exprs, aggr_funcs)
+        out = HashAggregateExec(agg.mode, cur, group_exprs, aggr_funcs,
+                                exact_floats=getattr(agg, "exact_floats", False))
     except Exception:
         return None
     # the rewrite must not change the aggregate's output contract

@@ -659,3 +659,31 @@ def ingest_stats(reset: bool = False) -> Dict[str, float]:
         max(0.0, 1.0 - out["wall_s"] / stages) if stages > 0 else 0.0
     )
     return out
+
+
+# accumulated device->host result readback across stage runs (bench.py
+# reports rows/bytes per config): every aggregate-result d2h transfer on
+# the device paths — full-column, fused top-k, fact-agg member/top-k —
+# records its width here. rows = trailing-axis length of each fetched
+# result (groups or selected candidates), bytes = the packed f32 transfer
+# size. The fused Sort+Limit epilogue's whole point is to shrink these to
+# O(limit); readbacks is the transfer count.
+_readback_lock = threading.Lock()
+_readback_totals = {"rows": 0, "bytes": 0, "readbacks": 0}
+
+
+def record_readback(rows: int, nbytes: int) -> None:
+    with _readback_lock:
+        _readback_totals["rows"] += int(rows)
+        _readback_totals["bytes"] += int(nbytes)
+        _readback_totals["readbacks"] += 1
+
+
+def readback_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated result-readback totals."""
+    with _readback_lock:
+        out = dict(_readback_totals)
+        if reset:
+            for k in _readback_totals:
+                _readback_totals[k] = 0
+    return out

@@ -47,12 +47,48 @@ from ballista_tpu.physical.scan import CsvScanExec, MemoryScanExec, ParquetScanE
 
 _SCAN_TYPES = (CsvScanExec, ParquetScanExec, MemoryScanExec)
 
+
+def plane_keys(idx: int) -> Tuple[int, int]:
+    """cols-dict keys for scan column idx's order-preserving int32 key
+    planes (ops/floatbits.py). Negative ints: scan columns are keyed by
+    their non-negative schema index, so both spaces share one dict through
+    the narrow/stage/persist machinery unchanged (layout-cache metas
+    stringify keys and re-int them cleanly). f32 columns use the hi slot
+    only; f64 columns carry (hi, lo) whose lexicographic signed order is
+    the f64 total order."""
+    return -2 * idx - 2, -2 * idx - 3
+
 # ceiling for the per-batch unrolled path (G linear passes); beyond it the
 # stage switches to the sorted chunked-segment layout (ops/layout.py), which
 # is O(N) regardless of group count
 MAX_GROUPS = 1024
 
 _INT32_MAX = 2**31 - 1
+
+# widest one-chunk-per-group cover the fused top-k epilogue will force;
+# beyond it (or past 4x row padding) the default chunking runs and the
+# epilogue's in-program fold variant takes over. HARD CEILING: the layout's
+# clen and jnp_expand_clen's arange are int16 (ops/layout.py:113,
+# stage.py:142) — an L1 past 2^14 would wrap chunk lengths silently.
+TOPK_MAX_L1 = 1 << 14
+
+
+def _topk_cover_L1(codes: np.ndarray, n_groups: int) -> Optional[int]:
+    """L1 giving the one-chunk-per-group cover the fused top-k epilogue
+    needs: the chunk fold becomes identity, so the k gathered columns are
+    bit-identical to what the full readback would emit. None when the
+    longest run exceeds TOPK_MAX_L1 or the cover's zero padding would blow
+    past ~4x the real rows (skewed runs) — the caller falls back to the
+    default chunking and fusion disables for the partition."""
+    if n_groups <= 0:
+        return None
+    longest = int(np.bincount(codes, minlength=n_groups).max())
+    L1 = 8
+    while L1 < longest:
+        L1 <<= 1
+    if L1 > TOPK_MAX_L1 or n_groups * L1 > max(4 * len(codes), 1 << 22):
+        return None
+    return L1
 
 
 class TooManyGroups(UnsupportedOnDevice):
@@ -257,7 +293,7 @@ def _upload_staged(staged: Dict, choices: Dict) -> Dict:
 class FusedAggregateStage:
     """Compiled device pipeline for one HashAggregateExec (partial phase)."""
 
-    def __init__(self, agg) -> None:
+    def __init__(self, agg, float_bits: bool = True) -> None:
         from ballista_tpu.physical.aggregate import AggregateFunc
 
         # --- walk the operator chain down to the row source --------------
@@ -343,6 +379,19 @@ class FusedAggregateStage:
         # bound-checked at prepare time and declines when int32 could
         # overflow a whole-batch masked sum
         self.int_exact: List[bool] = []
+        # float MIN/MAX over a plain column routes through the
+        # order-preserving bijection (ops/floatbits.py): the column's bits
+        # travel as int32 key planes, integer min/max is exact on device,
+        # and the readback inverts — bit-exact against the stored f64/f32
+        # value, so q2's equality-joined MIN needs no decline. Entries:
+        # None (f32 arithmetic path) | "f32" (one plane) | "f64" (hi/lo).
+        # The mesh path opts out (float_bits=False): its collectives fold
+        # rows independently, which cannot express the hi/lo lexicographic
+        # pair, and it keeps its documented f32 min/max semantics.
+        self.float_bits: List[Optional[str]] = []
+        # scan column index -> "f32" | "f64" (plane columns to materialize)
+        self._bit_planes: Dict[int, str] = {}
+        exact_required = bool(getattr(agg, "exact_floats", False))
         for a, ie in zip(self.aggs, self.agg_inputs):
             if a.fn == "count":
                 # COUNT counts NON-NULL inputs; the device mask-count would
@@ -354,24 +403,67 @@ class FusedAggregateStage:
                         raise UnsupportedOnDevice("COUNT over a string column")
                 self.value_fns.append(None)  # mask count only
                 self.int_exact.append(False)
-            else:
-                cv = self.compiler.compile(ie)
-                if cv.kind == "code":
-                    raise UnsupportedOnDevice("string aggregate input")
-                self.value_fns.append(cv)
-                # dates lower as int32 day counts: exact int min/max (the
-                # f32 route crashed assembling double -> date32, and values
-                # past 2^24 days would round)
-                self.int_exact.append(
-                    isinstance(ie, px.ColumnExpr)
-                    and (
-                        pa.types.is_integer(scan_schema.field(ie.index).type)
-                        or pa.types.is_date32(scan_schema.field(ie.index).type)
-                    )
+                self.float_bits.append(None)
+                continue
+            if (
+                float_bits
+                and a.fn in ("min", "max")
+                and isinstance(ie, px.ColumnExpr)
+                and pa.types.is_floating(scan_schema.field(ie.index).type)
+            ):
+                # bijected path: do NOT compile the input (that would upload
+                # the rounded f32 copy even when nothing else reads it); the
+                # planes are materialized directly from the Arrow column
+                width = (
+                    "f32"
+                    if pa.types.is_float32(scan_schema.field(ie.index).type)
+                    else "f64"
                 )
+                prior = self._bit_planes.setdefault(ie.index, width)
+                if prior != width:
+                    raise UnsupportedOnDevice("conflicting float plane widths")
+                self.value_fns.append(None)
+                self.int_exact.append(False)
+                self.float_bits.append(width)
+                continue
+            cv = self.compiler.compile(ie)
+            if cv.kind == "code":
+                raise UnsupportedOnDevice("string aggregate input")
+            if (
+                exact_required
+                and a.fn in ("min", "max")
+                and pa.types.is_floating(a.input_type)
+            ):
+                # equality-consumed float MIN/MAX over a COMPUTED expression:
+                # only plain columns carry exact bits; f32 arithmetic would
+                # round the result so it matches nothing — host path
+                raise UnsupportedOnDevice(
+                    "exact float min/max over a computed expression"
+                )
+            self.value_fns.append(cv)
+            # dates lower as int32 day counts: exact int min/max (the
+            # f32 route crashed assembling double -> date32, and values
+            # past 2^24 days would round)
+            self.int_exact.append(
+                isinstance(ie, px.ColumnExpr)
+                and (
+                    pa.types.is_integer(scan_schema.field(ie.index).type)
+                    or pa.types.is_date32(scan_schema.field(ie.index).type)
+                )
+            )
+            self.float_bits.append(None)
         self.scan_schema = scan_schema
         self.partial_schema = agg.schema() if agg.mode.value == "partial" else self._partial_schema(agg)
-        self._int_rows, self._folds = self._plan_outputs()
+        self._int_rows, self._folds, self._state_specs = self._plan_outputs()
+        # planner-annotated Sort+Limit epilogue (physical/planner.py): when
+        # eligible, the device step finishes with lax.top_k over the group
+        # scores and reads back `limit` rows instead of every group. Only
+        # SINGLE-mode aggregates carry the annotation, so one partial IS the
+        # final per-group state and on-device selection equals host
+        # selection (boundary ties fall back per query, see _topk_tail).
+        self.topk: Optional[dict] = self._topk_spec(agg)
+        self._topk_step = None  # built on first fused-eligible partition
+        self._topk_fold_step = None  # skewed-cover variant (in-program fold)
         self._step = self._build_step()
         self._sorted_step = None  # built on first high-cardinality partition
         self._device_cache: Dict[int, dict] = {}
@@ -407,24 +499,96 @@ class FusedAggregateStage:
     # ------------------------------------------------------------------
     def _plan_outputs(self):
         """Stacked-output plan shared by both device steps: row 0 is counts,
-        then one row per aggregate state column. Returns (is_int flags,
-        fold op names) per stacked row."""
+        then one row per aggregate state column — except f64-bijected
+        min/max states, which occupy TWO int32 rows (hi/lo key planes whose
+        lexicographic order is the f64 total order). Returns (is_int flags,
+        fold op names) per stacked row, plus one spec per partial-state
+        FIELD: (first logical row, kind, fold) with kind in
+        {"int", "num", "f32bits", "f64bits"} — the single source of truth
+        for row -> state-column mapping (postprocess_state_rows,
+        _fold_state_rows, the top-k epilogues, factagg's score row)."""
         int_rows = [True]  # counts
         folds = ["sum"]
-        for a, ix in zip(self.aggs, self.int_exact):
+        specs: List[Tuple[int, str, str]] = []
+        for a, ix, fb in zip(self.aggs, self.int_exact, self.float_bits):
+            row = len(int_rows)
             if a.fn == "count":
                 int_rows.append(True)
                 folds.append("sum")
+                specs.append((row, "int", "sum"))
             elif a.fn in ("sum", "avg"):
                 int_rows.append(ix)
                 folds.append("sum")
+                specs.append((row, "int" if ix else "num", "sum"))
                 if a.fn == "avg":
                     int_rows.append(True)
                     folds.append("sum")
-            else:  # min / max
+                    specs.append((row + 1, "int", "sum"))
+            elif fb == "f64":
+                int_rows.extend([True, True])
+                folds.extend([a.fn, a.fn])  # pair; never folded per-row
+                specs.append((row, "f64bits", a.fn))
+            elif fb == "f32":
+                int_rows.append(True)
+                folds.append(a.fn)
+                specs.append((row, "f32bits", a.fn))
+            else:  # min / max, arithmetic path
                 int_rows.append(ix)
                 folds.append(a.fn)
-        return int_rows, folds
+                specs.append((row, "int" if ix else "num", a.fn))
+        return int_rows, folds, specs
+
+    # keys wider than this decline the fusion ("unsupported multi-key
+    # widths"): each f64-bijected key spends TWO of the lexicographic
+    # int32 lanes the device sort ranks over
+    TOPK_MAX_KEY_LANES = 6
+
+    def _topk_spec(self, agg) -> Optional[dict]:
+        """Validate the planner's `_topk_pushdown` annotation against this
+        stage's output plan. Returns the enriched spec or None (ineligible:
+        the normal full-readback path runs unchanged).
+
+        Every sort key lowers to int32 lanes whose signed order equals the
+        key's order — exact int states as-is, f32 scores through the
+        floatbits bijection, f64-bijected min/max as their (hi, lo) plane
+        pair — so the device ranks one lexicographic int tuple. The group
+        index joins as the final lane: ties then resolve to the lowest
+        group exactly like the host's stable sort over the group-ordered
+        aggregate output, which makes the on-device selection identical to
+        the host Sort+Limit whenever the annotation covers every sort key."""
+        tk = getattr(agg, "_topk_pushdown", None)
+        if tk is None:
+            return None
+        mode = getattr(agg, "mode", None)
+        if mode is not None and mode.value != "single":
+            return None  # a per-partition partial top-k ranks partial sums
+        if not (1 <= tk["k"] <= (1 << 16)):
+            return None
+        key_dicts = tk.get("keys") or [
+            {"agg_index": tk["agg_index"], "descending": tk["descending"]}
+        ]
+        keyspecs: List[Tuple[int, str, bool]] = []
+        for kd in key_dicts:
+            j = kd.get("agg_index", -1)
+            if not (0 <= j < len(self.aggs)):
+                return None
+            if self.aggs[j].fn not in ("sum", "count", "min", "max"):
+                # avg finalizes to a RATIO of its two state rows; ranking
+                # the sum row would order by the wrong quantity
+                return None
+            field_idx = sum(len(a.state_fields()) for a in self.aggs[:j])
+            row, kind, _fold = self._state_specs[field_idx]
+            keyspecs.append((row, kind, bool(kd["descending"])))
+        n_lanes = sum(2 if kind == "f64bits" else 1 for _r, kind, _d in keyspecs)
+        if not keyspecs or n_lanes > self.TOPK_MAX_KEY_LANES:
+            return None
+        covered = bool(tk.get("covered", not tk.get("strict", False)))
+        return {
+            "k": int(tk["k"]),
+            "keys": keyspecs,
+            "covered": covered,
+            "n_lanes": n_lanes,
+        }
 
     def _stack_rows(self, rows):
         """Pack mixed int32/f32 result rows into ONE f32 array -> ONE
@@ -489,6 +653,18 @@ class FusedAggregateStage:
                 ]
             )
 
+        def seg_extreme_pair(hi, lo, safe_codes, num_segments, fill, red):
+            # lexicographic (hi, lo) extreme per group: lo competes only
+            # among rows whose hi equals the group's hi extreme
+            his, los = [], []
+            for g in range(num_segments):
+                in_g = safe_codes == g
+                h = red(jnp.where(in_g, hi, fill))
+                l = red(jnp.where(jnp.logical_and(in_g, hi == h), lo, fill))
+                his.append(h)
+                los.append(l)
+            return jnp.stack(his), jnp.stack(los)
+
         def step(num_segments, cols, aux, codes, row_valid):
             cols = widen_cols(cols)  # narrow residency -> canonical dtypes
             codes = codes.astype(jnp.int32)
@@ -506,6 +682,9 @@ class FusedAggregateStage:
                 ),
                 reduce_extreme=lambda v, fill, red: seg_extreme(
                     v, safe_codes, num_segments, fill, red
+                ),
+                reduce_extreme_pair=lambda hi, lo, fill, red: seg_extreme_pair(
+                    hi, lo, safe_codes, num_segments, fill, red
                 ),
             )
 
@@ -527,6 +706,15 @@ class FusedAggregateStage:
 
         filter_masks = self.filter_masks
 
+        def pair_axis1(hi, lo, fill, red):
+            # lexicographic (hi, lo) extreme per chunk: lo competes only
+            # among slots whose hi equals the chunk's hi extreme (masked
+            # slots carry fill in both planes, so an all-masked chunk
+            # yields the (fill, fill) sentinel pair)
+            h = red(hi, axis=1)
+            l = red(jnp.where(hi == h[:, None], lo, fill), axis=1)
+            return h, l
+
         def sstep(L1, cols, aux, clen):
             cols = widen_cols(cols)  # narrow residency -> canonical dtypes
             mask = jnp_expand_clen(clen, L1)
@@ -539,24 +727,48 @@ class FusedAggregateStage:
                 counts=jnp.sum(mask, axis=1, dtype=jnp.int32),
                 reduce_sum=lambda v, zero: jnp.sum(v, axis=1),
                 reduce_extreme=lambda v, fill, red: red(v, axis=1),
+                reduce_extreme_pair=pair_axis1,
             )
 
         return sstep
 
-    def _emit_rows(self, cols, aux, mask, counts, reduce_sum, reduce_extreme):
+    def _emit_rows(self, cols, aux, mask, counts, reduce_sum, reduce_extreme,
+                   reduce_extreme_pair=None):
         """Shared per-aggregate emission for both device cores. The row
         order/dtype contract here must stay in sync with _plan_outputs /
         _stack_rows / decode_packed_rows (and FactAggregateStage._score_row
         builds on it). Integer aggregates stay int32 (exact, range-checked
         at prepare time); masked-out slots use 0 for sums and +/-extreme
-        fills for min/max."""
+        fills for min/max. Float-bijected min/max reduces the int32 key
+        planes (pure integer select + compare — no float arithmetic exists
+        in that path, so the readback inverts to the bit-exact stored
+        value). With NaN declined at prepare, real keys never reach the
+        int32 extremes, so the +/-INT32_MAX fills stay out-of-band."""
         import jax.numpy as jnp
 
         maskf = mask.astype(jnp.float32)
         rows = [counts]
-        for a, vf, ix in zip(self.aggs, self.value_fns, self.int_exact):
+        for a, ie, vf, ix, fb in zip(
+            self.aggs, self.agg_inputs, self.value_fns, self.int_exact,
+            self.float_bits,
+        ):
             if a.fn == "count":
                 rows.append(counts)
+                continue
+            if fb is not None:
+                largest = a.fn == "max"
+                fill = -_INT32_MAX - 1 if largest else _INT32_MAX
+                red = jnp.max if largest else jnp.min
+                hk, lk = plane_keys(ie.index)
+                hi = jnp.where(mask, jnp.broadcast_to(cols[hk], mask.shape), fill)
+                if fb == "f32":
+                    rows.append(reduce_extreme(hi, fill, red))
+                else:
+                    lo = jnp.where(
+                        mask, jnp.broadcast_to(cols[lk], mask.shape), fill
+                    )
+                    h, l = reduce_extreme_pair(hi, lo, fill, red)
+                    rows.extend([h, l])
                 continue
             v = vf.fn(cols, aux)
             v = jnp.broadcast_to(v, mask.shape)
@@ -708,7 +920,31 @@ class FusedAggregateStage:
         for idx, dtype in self.compiler.used_columns.items():
             d = self.dicts.dicts.get(idx)
             cols[idx] = column_to_numpy(batch.column(idx), dtype, d)
+        for idx, width in self._bit_planes.items():
+            cols.update(self._lower_planes(batch.column(idx), idx, width))
         return cols
+
+    @staticmethod
+    def _lower_planes(arr, idx: int, width: str) -> Dict[int, np.ndarray]:
+        """Bijected min/max input: lower the RAW Arrow float column to its
+        order-preserving int32 key plane(s) — never through the f32 device
+        copy, which would round f64 values. Declines on NaN: Arrow's host
+        min/max SKIPS NaN, and no single key order can make a value both
+        never-min and never-max."""
+        from ballista_tpu.ops import floatbits
+
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        if arr.null_count:
+            raise UnsupportedOnDevice("null values in device column")
+        vals = arr.to_numpy(zero_copy_only=False)
+        if np.isnan(vals).any():
+            raise UnsupportedOnDevice("NaN in float min/max column")
+        hk, lk = plane_keys(idx)
+        if width == "f32":
+            return {hk: floatbits.f32_to_i32(vals.astype(np.float32, copy=False))}
+        hi, lo = floatbits.i64_to_planes(floatbits.f64_to_i64(vals))
+        return {hk: hi, lk: lo}
 
     def _prepare_partition(self, partition: int, ctx) -> List[dict]:
         """Host work for one partition: scan, encode, pad, transfer. Returns
@@ -965,16 +1201,43 @@ class FusedAggregateStage:
             # fact stages (sorted_cover_max) consume [V, L1] tiles + rank
             # metadata the pallas entry doesn't carry
             and not getattr(self, "sorted_cover_max", False)
+            # the fused top-k epilogue composes with the layout core only
+            and self.topk is None
             # counts accumulate in f32 inside the kernel: exact only below 2^24
             and batch.num_rows <= (1 << 24)
         ):
             return self._prepare_pallas_sorted(batch, codes, key_values, n_groups, ctx)
-        layout = SortedSegmentLayout(
-            codes, n_groups, cover_max=getattr(self, "sorted_cover_max", False)
-        )
-        del codes
-        npcols = self._lower_columns(batch)
-        self._check_int_ranges(npcols, layout.L1)
+        layout = None
+        if self.topk is not None and not getattr(self, "sorted_cover_max", False):
+            # fused top-k wants the one-chunk-per-group cover: the chunk
+            # fold becomes identity, so the gathered k columns are the
+            # BIT-IDENTICAL values the full readback would emit. The int
+            # range check runs against the cover width (a whole-group sum
+            # in one chunk); failing either check falls back to the
+            # default chunking below — fusion per-partition degrades to the
+            # in-program fold or the full readback, the normal path is
+            # untouched. Only THIS branch lowers columns before the layout:
+            # the default ordering below (layout first, codes freed, then
+            # lower) keeps the documented SF=100 host-memory peak.
+            npcols = self._lower_columns(batch)
+            cover_L1 = _topk_cover_L1(codes, n_groups)
+            if cover_L1 is not None:
+                try:
+                    self._check_int_ranges(npcols, cover_L1)
+                    layout = SortedSegmentLayout(codes, n_groups, force_L1=cover_L1)
+                except UnsupportedOnDevice:
+                    layout = None
+            if layout is None:
+                layout = SortedSegmentLayout(codes, n_groups)
+                self._check_int_ranges(npcols, layout.L1)
+            del codes
+        else:
+            layout = SortedSegmentLayout(
+                codes, n_groups, cover_max=getattr(self, "sorted_cover_max", False)
+            )
+            del codes
+            npcols = self._lower_columns(batch)
+            self._check_int_ranges(npcols, layout.L1)
         # derived columns read row-space npcols; compute BEFORE the staging
         # loop below starts freeing them
         derived_raw = {name: fn(npcols) for name, fn in self.derive_columns.items()}
@@ -1299,11 +1562,19 @@ class FusedAggregateStage:
                     prepared = self._load_layout(partition, ctx)
                     freshly_prepared = prepared is not None
                 if prepared is None:
-                    try:
-                        prepared = {"kind": "batches",
-                                    "entries": self._prepare_partition(partition, ctx)}
-                    except TooManyGroups:
+                    if self.topk is not None:
+                        # the fused top-k epilogue needs ONE device call
+                        # over the whole partition (per-batch group codes
+                        # are batch-local); the sorted prepare itself
+                        # decides per partition whether fusion is live
+                        # (one-chunk cover) or the normal path runs
                         prepared = self._prepare_partition_sorted(partition, ctx)
+                    else:
+                        try:
+                            prepared = {"kind": "batches",
+                                        "entries": self._prepare_partition(partition, ctx)}
+                        except TooManyGroups:
+                            prepared = self._prepare_partition_sorted(partition, ctx)
                     freshly_prepared = True
                 if freshly_prepared and use_cache:
                     from ballista_tpu.ops.runtime import (
@@ -1329,6 +1600,10 @@ class FusedAggregateStage:
         if prepared["kind"] == "empty":
             return self.partial_schema.empty_table()
         if prepared["kind"] == "sorted":
+            if self._topk_eligible(prepared):
+                out = self._run_topk(prepared, aux)
+                if out is not None:
+                    return out  # None: boundary tie -> full readback below
             return self._run_sorted(prepared, aux)
         if prepared["kind"] == "pallas_sorted":
             return self._run_pallas_sorted(prepared, aux)
@@ -1336,7 +1611,7 @@ class FusedAggregateStage:
         # dispatch all batches asynchronously, then materialize same-shaped
         # outputs in one stacked d2h transfer — per-batch fetches would pay
         # the relay round-trip k times (runtime.fetch_arrays)
-        from ballista_tpu.ops.runtime import fetch_arrays
+        from ballista_tpu.ops.runtime import fetch_arrays, record_readback
 
         pending = []
         for ent in prepared["entries"]:
@@ -1345,13 +1620,16 @@ class FusedAggregateStage:
             )
             pending.append((stacked_dev, ent))
         fetched = fetch_arrays([dev for dev, _ in pending])
+        record_readback(
+            sum(f.shape[-1] for f in fetched), sum(f.nbytes for f in fetched)
+        )
 
         partial_tables: List[pa.Table] = []
         for stacked_np, (_, ent) in zip(fetched, pending):
             rows = self._decode_stacked(stacked_np)
             n_groups = ent["n_groups"]
             counts_np = rows[0][:n_groups]
-            outputs = [o[:n_groups] for o in rows[1:]]
+            outputs = [o[:n_groups] for o in self._state_outputs(rows)]
             t = self._assemble_partial(outputs, counts_np, ent["key_values"], n_groups)
             if t.num_rows:
                 partial_tables.append(t)
@@ -1363,19 +1641,275 @@ class FusedAggregateStage:
         """Undo _stack_rows' int32 hi/lo packing."""
         return decode_packed_rows(stacked, self._int_rows)
 
+    def _state_outputs(self, rows: List[np.ndarray]) -> List[np.ndarray]:
+        """Decoded logical rows -> one output column per partial-state
+        FIELD (spec-driven; bijected min/max states invert through
+        ops/floatbits.py, f64 pairs recombining their planes first). Empty
+        groups still carry key-space sentinel fills here — every caller
+        masks them with counts==0 before assembly."""
+        from ballista_tpu.ops import floatbits
+
+        outs: List[np.ndarray] = []
+        for row, kind, _fold in self._state_specs:
+            if kind == "f64bits":
+                outs.append(
+                    floatbits.i64_to_f64(
+                        floatbits.planes_to_i64(rows[row], rows[row + 1])
+                    )
+                )
+            elif kind == "f32bits":
+                outs.append(
+                    floatbits.i32_to_f32(rows[row].astype(np.int32)).astype(
+                        np.float64
+                    )
+                )
+            else:
+                outs.append(rows[row])
+        return outs
+
+    def _fold_state_rows(self, layout, rows: List[np.ndarray]) -> List[np.ndarray]:
+        """Fold decoded per-chunk partial rows to per-group state columns.
+        f64-bijected pairs recombine into int64 keys BEFORE the fold —
+        lexicographic (hi, lo) min/max IS int64 key min/max, and reduceat
+        over int keys is exact — then invert to the bit-exact float."""
+        from ballista_tpu.ops import floatbits
+
+        folds = {"sum": layout.fold_sum, "min": layout.fold_min,
+                 "max": layout.fold_max}
+        outs: List[np.ndarray] = []
+        for row, kind, fold in self._state_specs:
+            if kind == "f64bits":
+                keys = floatbits.planes_to_i64(rows[row], rows[row + 1])
+                outs.append(floatbits.i64_to_f64(folds[fold](keys)))
+            elif kind == "f32bits":
+                k32 = folds[fold](rows[row]).astype(np.int32)
+                outs.append(floatbits.i32_to_f32(k32).astype(np.float64))
+            else:
+                outs.append(folds[fold](rows[row]))
+        return outs
+
     def _run_sorted(self, ent: dict, aux) -> pa.Table:
+        from ballista_tpu.ops.runtime import record_readback
+
         layout = ent["layout"]
         stacked = np.asarray(
             self._sorted_step(ent["layout"].L1, ent["cols"], aux, ent["clen"])
         )
+        record_readback(stacked.shape[-1], stacked.nbytes)
         rows = self._decode_stacked(stacked)
-        folds = {"sum": layout.fold_sum, "min": layout.fold_min,
-                 "max": layout.fold_max}
         counts = layout.fold_sum(rows[0])
-        outputs = [folds[f](r) for f, r in zip(self._folds[1:], rows[1:])]
+        outputs = self._fold_state_rows(layout, rows)
         return self._assemble_partial(
             outputs, counts, ent["key_values"], ent["n_groups"]
         )
+
+    # -- fused Sort+Limit epilogue (planner _topk_pushdown) -------------
+    def _topk_eligible(self, ent: dict) -> bool:
+        """Fusion is live for a partition when the selection can actually
+        exclude groups AND the device can produce exact per-group states:
+        either the layout carries the one-chunk cover (chunk partials ARE
+        the group states, bit-identical to the full readback) or the fold
+        variant runs (in-program chunk->group segment fold for skewed
+        layouts, e.g. q10's dominant unmatched-row group). The fold variant
+        sums int32 in-program where the host fold widens to int64, so
+        int-exact SUM aggregates disable it — the normal full readback runs
+        instead, same entry, identical values."""
+        if (
+            self.topk is None
+            or ent.get("layout") is None
+            or ent["n_groups"] <= self.topk["k"]
+        ):
+            return False
+        if ent["layout"].one_chunk_per_group:
+            return True
+        return not any(
+            ix and a.fn in ("sum", "avg")
+            for a, ix in zip(self.aggs, self.int_exact)
+        )
+
+    def _build_topk_step(self, fold: bool):
+        import jax
+
+        if fold:
+            # (L1, cols, aux, clen, G, owner): G is the segment count
+            return jax.jit(self._topk_core(True), static_argnums=(0, 4))
+        return jax.jit(self._topk_core(False), static_argnums=(0,))
+
+    def _topk_core(self, fold: bool):
+        """Device Sort+Limit epilogue composed over the sorted core: lower
+        every sort key to int32 lanes whose signed order equals the key
+        order (exact int states as-is, f32 scores through the floatbits
+        bijection, f64-bijected states as their hi/lo plane pair; bitwise
+        NOT flips descending keys without overflow), lexicographically sort
+        (validity, key lanes..., group index) and gather the k best columns
+        of the packed state stack. The trailing group-index lane makes tie
+        order identical to the host's stable sort over the group-ordered
+        aggregate output. Readback: [R_packed + E, k] instead of
+        [R_packed, G] — E carries the k-th and (k+1)-th lane values (the
+        boundary-tie probe) and the selected group indices, all as exact
+        f32 halves like _stack_rows.
+
+        fold=False: the one-chunk cover — chunk partials are already group
+        states. fold=True: chunk partials segment-fold to group states
+        in-program first (sum/min/max per _state_specs; f64-bijected pairs
+        fold lexicographically — lo competes only among chunks holding the
+        group's hi extreme). min/max folds match the host reduceat exactly;
+        f32 sums regroup the accumulation (documented device tolerance);
+        int-exact sums never take this variant (_topk_eligible)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ballista_tpu.ops.floatbits import jnp_f32_to_i32
+
+        core = self._sorted_core()
+        pos = packed_positions(self._int_rows)
+        int_rows = self._int_rows
+        specs = self._state_specs
+        k = self.topk["k"]
+        keyspecs = self.topk["keys"]
+
+        def split16(x):
+            return (x >> 16).astype(jnp.float32), (x & 0xFFFF).astype(jnp.float32)
+
+        def select(G, counts, row_of, gstack):
+            """Shared tail over per-group states: row_of(r) is the DECODED
+            logical row r ([G] int32, or f32 for num rows); gstack the
+            packed [R_packed, G] stack the readback decodes."""
+            # validity leads the lexicographic key: empty groups (dropped
+            # by the unfused assembly) must never displace a real group
+            lanes = [jnp.where(counts > 0, 0, 1).astype(jnp.int32)]
+            for row, kind, desc in keyspecs:
+                if kind == "num":
+                    kv = [jnp_f32_to_i32(row_of(row))]
+                elif kind == "f64bits":
+                    kv = [row_of(row), row_of(row + 1)]
+                else:  # "int" / "f32bits": exact int32 state
+                    kv = [row_of(row)]
+                lanes.extend(~v if desc else v for v in kv)
+            iota = jnp.arange(G, dtype=jnp.int32)
+            srt = jax.lax.sort(tuple(lanes) + (iota,), num_keys=len(lanes) + 1)
+            sel_idx = srt[-1][:k]
+            sel = jnp.take(gstack, sel_idx, axis=1)
+            extra = []
+            for lane_sorted in srt[:-1]:
+                for v in (lane_sorted[k - 1], lane_sorted[k]):
+                    hi, lo = split16(v)
+                    extra.append(jnp.full((k,), hi, jnp.float32))
+                    extra.append(jnp.full((k,), lo, jnp.float32))
+            ih, il = split16(sel_idx)
+            extra.extend([ih, il])
+            return jnp.concatenate([sel, jnp.stack(extra)])
+
+        if not fold:
+
+            def tstep(L1, cols, aux, clen):
+                stacked = core(L1, cols, aux, clen)  # [R_packed, G]
+                G = stacked.shape[1]
+
+                def row_of(row):
+                    p = pos[row]
+                    if int_rows[row]:
+                        return jnp_unpack_i32(stacked[p], stacked[p + 1])
+                    return stacked[p]
+
+                return select(G, row_of(0), row_of, stacked)
+
+            return tstep
+
+        seg = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+               "max": jax.ops.segment_max}
+
+        def tstep_fold(L1, cols, aux, clen, G, owner):
+            stacked = core(L1, cols, aux, clen)  # [R_packed, V] chunk partials
+
+            def chunk_row(row):
+                p = pos[row]
+                if int_rows[row]:
+                    return jnp_unpack_i32(stacked[p], stacked[p + 1])
+                return stacked[p]
+
+            def red(fop, v):
+                return seg[fop](v, owner, num_segments=G,
+                                indices_are_sorted=True)
+
+            logical = {0: red("sum", chunk_row(0))}  # counts
+            for row, kind, fop in specs:
+                if kind == "f64bits":
+                    hi, lo = chunk_row(row), chunk_row(row + 1)
+                    h = red(fop, hi)
+                    fill = jnp.int32(
+                        _INT32_MAX if fop == "min" else -_INT32_MAX - 1
+                    )
+                    l = red(fop, jnp.where(hi == jnp.take(h, owner), lo, fill))
+                    logical[row], logical[row + 1] = h, l
+                else:
+                    logical[row] = red(fop, chunk_row(row))
+            packed = []
+            for row, is_int in enumerate(int_rows):
+                if is_int:
+                    packed.extend(split16(logical[row]))
+                else:
+                    packed.append(logical[row])
+            return select(G, logical[0], lambda r: logical[r],
+                          jnp.stack(packed))
+
+        return tstep_fold
+
+    def _run_topk(self, ent: dict, aux) -> Optional[pa.Table]:
+        """Fused-epilogue readback: k columns + boundary probe. Returns
+        None (caller falls back to the full readback, same entry, same
+        values) when un-fused trailing sort keys exist AND the k-th and
+        (k+1)-th groups tie on every fused lane — the only case where the
+        device selection could exclude a group the host order admits."""
+        from ballista_tpu.ops.runtime import record_readback
+
+        import jax.numpy as jnp
+
+        spec = self.topk
+        k = spec["k"]
+        layout = ent["layout"]
+        if layout.one_chunk_per_group:
+            if self._topk_step is None:
+                self._topk_step = self._build_topk_step(fold=False)
+            packed = np.asarray(
+                self._topk_step(layout.L1, ent["cols"], aux, ent["clen"])
+            )
+        else:
+            # skewed cover: fold chunk partials to group states in-program
+            if self._topk_fold_step is None:
+                self._topk_fold_step = self._build_topk_step(fold=True)
+            owner = ent.get("owner_dev")
+            if owner is None:
+                owner = ent["owner_dev"] = jnp.asarray(
+                    layout.owner.astype(np.int32)
+                )
+            packed = np.asarray(
+                self._topk_fold_step(layout.L1, ent["cols"], aux, ent["clen"],
+                                     ent["n_groups"], owner)
+            )
+        record_readback(packed.shape[-1], packed.nbytes)
+        nl = 1 + spec["n_lanes"]
+        E = 4 * nl + 2
+        sel, tail = packed[:-E], packed[-E:]
+        lasts, bounds = [], []
+        for i in range(nl):
+            b = 4 * i
+            lasts.append(int(tail[b][0]) * 65536 + int(tail[b + 1][0]))
+            bounds.append(int(tail[b + 2][0]) * 65536 + int(tail[b + 3][0]))
+        if not spec["covered"] and lasts == bounds and lasts[0] == 0:
+            return None  # boundary tie under un-fused tie-breakers
+        idx = tail[-2].astype(np.int64) * 65536 + tail[-1].astype(np.int64)
+        order = np.argsort(idx, kind="stable")
+        idx = idx[order]
+        rows = [r[order] for r in self._decode_stacked(sel)]
+        counts = rows[0]
+        outputs = self._state_outputs(rows)
+        take = pa.array(idx)
+        key_values = [
+            (kv if isinstance(kv, (pa.Array, pa.ChunkedArray)) else pa.array(kv)).take(take)
+            for kv in ent["key_values"]
+        ]
+        return self._assemble_partial(outputs, counts, key_values, len(idx))
 
     def _assemble_partial(
         self,

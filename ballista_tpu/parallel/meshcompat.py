@@ -1,0 +1,25 @@
+"""jax `shard_map` compatibility shim.
+
+Newer jax exports the stable `jax.shard_map` (replication checking under
+the `check_vma` keyword); 0.4.x ships the same transform as
+`jax.experimental.shard_map.shard_map` with the older `check_rep` keyword.
+Mesh call sites import `shard_map` from here and always pass `check_vma` —
+without this shim every mesh program on a 0.4.x image died at import time
+and silently fell back to the host path (observed: the whole spmd suite
+red on the CI image while results stayed "correct" via fallback).
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)

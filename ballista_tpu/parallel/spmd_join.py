@@ -301,7 +301,7 @@ class SpmdJoinExec(ExecutionPlan):
 
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from ballista_tpu.parallel.meshcompat import shard_map
         from jax.sharding import PartitionSpec as P
 
         def a2a(x):

@@ -275,7 +275,12 @@ class SpmdAggregateExec(ExecutionPlan):
             # equality-joined against exact f64 values — host subplan instead
             raise UnsupportedOnDevice("exact float min/max required")
         if self._stage is None:
-            self._stage = FusedAggregateStage(self.partial)
+            # float_bits=False: the mesh exchange folds rows independently
+            # (per-row psum/pmin/pmax collectives), which cannot express the
+            # lexicographic hi/lo f64 key-plane pair — this path keeps its
+            # documented f32 float min/max semantics (the exact-float decline
+            # above already routes q2-shape queries to the host subplan)
+            self._stage = FusedAggregateStage(self.partial, float_bits=False)
         stage = self._stage
         mesh = self._build_mesh(ctx)
         n_dev = int(np.prod(list(mesh.shape.values())))
@@ -737,7 +742,7 @@ class SpmdAggregateExec(ExecutionPlan):
 
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from ballista_tpu.parallel.meshcompat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ballista_tpu.ops.stage import jnp_unpack_i32
@@ -798,7 +803,7 @@ class SpmdAggregateExec(ExecutionPlan):
 
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from ballista_tpu.parallel.meshcompat import shard_map
         from jax.sharding import PartitionSpec as P
 
         from ballista_tpu.ops.stage import jnp_unpack_i32

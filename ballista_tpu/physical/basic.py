@@ -9,7 +9,7 @@ EmptyExec.
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import pyarrow as pa
 import pyarrow.compute as pc
@@ -282,15 +282,21 @@ class SortExec(ExecutionPlan):
         for i, (expr, asc, nulls_first) in enumerate(self.sort_keys):
             key_arrays.append(_as_array(expr.evaluate(batch), n))
             names.append(f"__sort_{i}")
-        key_table = pa.table(dict(zip(names, key_arrays)))
-        sort_opts = [
-            (
-                names[i],
-                "ascending" if asc else "descending",
-                "at_start" if nf else "at_end",
-            )
-            for i, (_, asc, nf) in enumerate(self.sort_keys)
-        ]
+        # pyarrow's sort_keys are (name, order) pairs with one GLOBAL
+        # null_placement — per-key nulls_first is expressed by leading each
+        # nullable key with its validity column (no nulls), so the key's own
+        # nulls only ever compare against other nulls and the global
+        # placement is irrelevant
+        columns: Dict[str, pa.Array] = {}
+        sort_opts = []
+        for i, ((_, asc, nf), arr) in enumerate(zip(self.sort_keys, key_arrays)):
+            if arr.null_count:
+                columns[f"__nv_{i}"] = pc.is_null(arr)
+                # True (null) first <=> descending on the bool validity key
+                sort_opts.append((f"__nv_{i}", "descending" if nf else "ascending"))
+            columns[names[i]] = arr
+            sort_opts.append((names[i], "ascending" if asc else "descending"))
+        key_table = pa.table(columns)
         indices = pc.sort_indices(key_table, sort_keys=sort_opts)
         if self.fetch is not None:
             indices = indices.slice(0, self.fetch)

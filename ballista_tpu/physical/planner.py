@@ -100,11 +100,23 @@ class PhysicalPlanner:
     @staticmethod
     def _annotate_topk(root: ExecutionPlan) -> None:
         """Mark Limit(Sort(Projection?(Aggregate))) chains on the aggregate:
-        the TPU fact-aggregation path (ops/factagg.py) uses the annotation to
-        fuse a device top-k epilogue so only ~4k candidate groups are read
-        back instead of all of them. Host execution ignores it: the
-        aggregate still emits every group unless a device stage honors the
-        hint, and the Sort/Limit above always re-applies the full ordering."""
+        the device aggregate stages (ops/factagg.py candidate pool,
+        ops/stage.py fused lexicographic top-k epilogue) use the annotation
+        to read back only ~k rows instead of every group. Host execution
+        ignores it: the aggregate still emits every group unless a device
+        stage honors the hint, and the Sort/Limit above always re-applies
+        the full ordering, so the annotation can only ever shrink the set of
+        rows the aggregate returns — never reorder or widen it.
+
+        The annotation resolves the LONGEST PREFIX of sort keys that are
+        aggregate outputs into ``keys`` (ops/stage.py lowers each to
+        order-preserving int lanes and sorts lexicographically).
+        ``covered`` is True when that prefix is the whole ORDER BY — the
+        device selection is then exactly the host selection; otherwise the
+        consumer must detect boundary ties on the fused lanes and fall back
+        (un-fused trailing tie-breakers could admit a different row).
+        ``agg_index``/``descending``/``strict`` mirror the first key for
+        the single-score consumers (factagg's block-max candidate pool)."""
         from ballista_tpu.physical import expr as px
         from ballista_tpu.physical.aggregate import AggregateMode, HashAggregateExec
         from ballista_tpu.physical.basic import GlobalLimitExec, ProjectionExec, SortExec
@@ -123,24 +135,30 @@ class PhysicalPlanner:
                 proj, p = p, p.input
             if not isinstance(p, HashAggregateExec) or p.mode != AggregateMode.SINGLE:
                 return
-            first, asc, _nulls = s.sort_keys[0]
-            if not isinstance(first, px.ColumnExpr):
-                return
-            idx = first.index
-            if proj is not None:
-                e = proj.exprs[idx][0]
-                if not isinstance(e, px.ColumnExpr):
-                    return
-                idx = e.index
             ngroup = len(p.group_exprs)
-            if idx < ngroup:
-                return  # ordered by a group key, not an aggregate value
+            keys = []
+            for expr, asc, _nulls in s.sort_keys:
+                if not isinstance(expr, px.ColumnExpr):
+                    break
+                idx = expr.index
+                if proj is not None:
+                    e = proj.exprs[idx][0]
+                    if not isinstance(e, px.ColumnExpr):
+                        break
+                    idx = e.index
+                if idx < ngroup:
+                    break  # a group key, not an aggregate value
+                keys.append({"agg_index": idx - ngroup, "descending": not asc})
+            if not keys:
+                return
             p._topk_pushdown = {
-                "agg_index": idx - ngroup,
-                "descending": not asc,
+                "agg_index": keys[0]["agg_index"],
+                "descending": keys[0]["descending"],
                 "k": int(node.limit) + int(getattr(node, "skip", 0) or 0),
-                # secondary sort keys make tie order deterministic; the
-                # device candidate pool must detect boundary ties then
+                "keys": keys,
+                "covered": len(keys) == len(s.sort_keys),
+                # sort keys beyond the first make tie order deterministic;
+                # single-score consumers must detect boundary ties then
                 "strict": len(s.sort_keys) > 1,
             }
 

@@ -28,10 +28,71 @@ from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
 EXECUTOR_LEASE_SECS = 60.0  # ref state/mod.rs:42
 
 
+class _TaskIndex:
+    """Per-stage pending/incomplete index over task statuses.
+
+    assign_next_schedulable_task previously re-scanned (and re-parsed) EVERY
+    task protobuf in the KV under the global scheduler lock on every poll —
+    O(total tasks) per idle poll. The index keeps, per (job_id, stage_id):
+    the pending partitions (status oneof unset), the not-yet-completed
+    partitions (answers "is this upstream stage fully done" in O(1)), and
+    the total task count (a stage with NO tasks is never a satisfied
+    dependency). It is seeded lazily from one full scan — a restarted
+    scheduler on a durable backend resumes correctly — and then maintained
+    on every save_task_status transition, which is the single write path
+    for task state (planning, poll updates, lost-task resets)."""
+
+    def __init__(self) -> None:
+        self.pending: Dict[Tuple[str, int], set] = {}
+        self.incomplete: Dict[Tuple[str, int], set] = {}
+        self.total: Dict[Tuple[str, int], set] = {}
+
+    def observe(self, status: pb.TaskStatus) -> None:
+        pid = status.partition_id
+        key = (pid.job_id, pid.stage_id)
+        part = pid.partition_id
+        self.total.setdefault(key, set()).add(part)
+        w = status.WhichOneof("status")
+        if w is None:
+            self.pending.setdefault(key, set()).add(part)
+        else:
+            self._drop(self.pending, key, part)
+        if w == "completed":
+            self._drop(self.incomplete, key, part)
+        else:
+            self.incomplete.setdefault(key, set()).add(part)
+
+    @staticmethod
+    def _drop(index: Dict[Tuple[str, int], set], key, part) -> None:
+        """Remove part from index[key], deleting drained entries — a
+        long-lived scheduler must not re-sort every stage it ever saw on
+        each poll."""
+        s = index.get(key)
+        if s is None:
+            return
+        s.discard(part)
+        if not s:
+            del index[key]
+
+    def stage_done(self, job_id: str, stage_id: int) -> bool:
+        key = (job_id, stage_id)
+        return bool(self.total.get(key)) and not self.incomplete.get(key)
+
+
+# a peer scheduler sharing the namespace writes tasks this instance's index
+# never observes; re-seed from a full scan at most this often so peer-
+# submitted jobs are discovered within a bounded delay (single-scheduler
+# deployments see every write through save_task_status and never need it,
+# but still pay at most one scan per interval instead of one per poll)
+TASK_INDEX_RESEED_SECS = 5.0
+
+
 class SchedulerState:
     def __init__(self, kv: KvBackend, namespace: str = "default") -> None:
         self.kv = kv
         self.namespace = namespace
+        self._task_index: Optional[_TaskIndex] = None
+        self._task_index_seeded_at = 0.0
 
     def _key(self, *parts: str) -> str:
         return "/".join(("/ballista", self.namespace) + parts)
@@ -110,6 +171,27 @@ class SchedulerState:
             self._key("tasks", pid.job_id, str(pid.stage_id), str(pid.partition_id)),
             status.SerializeToString(),
         )
+        if self._task_index is not None:
+            self._task_index.observe(status)
+
+    def _ensure_task_index(self) -> _TaskIndex:
+        """Seed the per-stage task index from one full scan, then keep it
+        current through save_task_status — and RE-seed at most every
+        TASK_INDEX_RESEED_SECS so peer-scheduler writes (new jobs, lost-task
+        resets) are discovered with bounded delay instead of never.
+        Assignment additionally re-verifies the chosen task's pending state
+        and every upstream status from the KV before acting on them."""
+        now = time.monotonic()
+        if (
+            self._task_index is None
+            or now - self._task_index_seeded_at > TASK_INDEX_RESEED_SECS
+        ):
+            idx = _TaskIndex()
+            for t in self.get_all_tasks():
+                idx.observe(t)
+            self._task_index = idx
+            self._task_index_seeded_at = now
+        return self._task_index
 
     def get_task_status(self, job_id: str, stage_id: int, partition: int) -> Optional[pb.TaskStatus]:
         v = self.kv.get(self._key("tasks", job_id, str(stage_id), str(partition)))
@@ -122,6 +204,15 @@ class SchedulerState:
     def get_job_tasks(self, job_id: str) -> List[pb.TaskStatus]:
         out = []
         for _k, v in self.kv.get_prefix(self._key("tasks", job_id)):
+            t = pb.TaskStatus()
+            t.ParseFromString(v)
+            out.append(t)
+        return out
+
+    def get_stage_tasks(self, job_id: str, stage_id: int) -> List[pb.TaskStatus]:
+        # trailing "/": the bare prefix "tasks/j/2" would also match stage 20
+        out = []
+        for _k, v in self.kv.get_prefix(self._key("tasks", job_id, str(stage_id)) + "/"):
             t = pb.TaskStatus()
             t.ParseFromString(v)
             out.append(t)
@@ -175,33 +266,51 @@ class SchedulerState:
     def assign_next_schedulable_task(
         self, executor_id: str
     ) -> Optional[Tuple[pb.TaskStatus, object]]:
-        """Linear scan for a runnable pending task (ref state/mod.rs:182-260):
-        a task is runnable when every upstream stage it reads from has all
-        tasks completed. Marks it Running and returns (status, bound plan)."""
-        tasks = self.get_all_tasks()
-        by_stage: Dict[Tuple[str, int], List[pb.TaskStatus]] = {}
-        for t in tasks:
-            by_stage.setdefault(
-                (t.partition_id.job_id, t.partition_id.stage_id), []
-            ).append(t)
-
-        for task in tasks:
-            if task.WhichOneof("status") is not None:
-                continue  # already running/completed/failed
-            job_id = task.partition_id.job_id
-            stage_id = task.partition_id.stage_id
+        """Index-driven pick of a runnable pending task: a task is runnable
+        when every upstream stage it reads from has all tasks completed
+        (ref state/mod.rs:182-260 does this as a linear scan over every
+        task). The per-stage index narrows the work to stages that actually
+        have pending tasks, with O(1) upstream-completeness checks; only a
+        chosen stage's upstream statuses are read back from the KV (for
+        shuffle locations). Candidate order matches the linear scan's KV
+        key order — tests/test_scheduler_state.py asserts identity on
+        randomized DAGs. Marks the pick Running and returns
+        (status, bound plan)."""
+        idx = self._ensure_task_index()
+        # KV keys order stage/partition ids as STRINGS ("10" < "2"); the
+        # scan this replaces inherited that order from get_prefix
+        for job_id, stage_id in sorted(
+            idx.pending, key=lambda k: (k[0], str(k[1]))
+        ):
+            # .get: an earlier iteration's upstream KV refresh may have
+            # drained (and dropped) this stage's entry mid-iteration
+            parts = idx.pending.get((job_id, stage_id))
+            if not parts:
+                continue
             plan = self.get_stage_plan(job_id, stage_id)
             if plan is None:
                 continue
             unresolved = find_unresolved_shuffles(plan)
             locations: Dict[int, List[ShuffleLocation]] = {}
-            runnable = True
+            blocked = False
             for u in unresolved:
-                upstream = by_stage.get((job_id, u.stage_id), [])
+                # O(1) screen: stages the index knows are incomplete skip
+                # the KV read entirely (staleness toward "peer completed
+                # it" is bounded by the periodic reseed)
+                if not idx.stage_done(job_id, u.stage_id):
+                    blocked = True
+                    break
+                # the locations are built from FRESH KV statuses with a
+                # final completeness check — a peer's lost-task reset
+                # (completed -> pending, unseen by this index) must block
+                # the stage, not hand out empty executor/path locations
+                upstream = self.get_stage_tasks(job_id, u.stage_id)
+                for t in upstream:
+                    idx.observe(t)
                 if not upstream or any(
                     t.WhichOneof("status") != "completed" for t in upstream
                 ):
-                    runnable = False
+                    blocked = True
                     break
                 locs = []
                 for t in sorted(upstream, key=lambda t: t.partition_id.partition_id):
@@ -213,12 +322,22 @@ class SchedulerState:
                         )
                     )
                 locations[u.stage_id] = locs
-            if not runnable:
+            if blocked:
                 continue
             bound = remove_unresolved_shuffles(plan, locations) if unresolved else plan
-            # mark running
+            partition = min(parts, key=str)
+            # re-verify from the KV before claiming: the index is local to
+            # this SchedulerState; a peer scheduler (or an expired write)
+            # must not lead to a double assignment
+            current = self.get_task_status(job_id, stage_id, partition)
+            if current is None or current.WhichOneof("status") is not None:
+                if current is None:
+                    idx.pending[(job_id, stage_id)].discard(partition)
+                else:
+                    idx.observe(current)
+                continue
             running = pb.TaskStatus()
-            running.partition_id.CopyFrom(task.partition_id)
+            running.partition_id.CopyFrom(current.partition_id)
             running.running.executor_id = executor_id
             self.save_task_status(running)
             return running, bound

@@ -108,8 +108,11 @@ def run_once(backend: str, sql: str, sf: float = SF) -> float:
     return dt
 
 
-def _probe_device_once(timeout_s: int) -> str | None:
-    """Returns None when the device backend answered, else the error tail."""
+def _probe_device_once(timeout_s: int) -> dict | None:
+    """Returns None when the device backend answered, else a structured
+    failure record: {"reason": "timeout"|"error", "timeout_s": <budget>,
+    "detail": <stderr tail>} — a jax.devices() hang and a crashed probe are
+    different operational problems and the BENCH JSON must say which."""
     import subprocess
 
     code = "import jax; print(jax.devices())"
@@ -121,7 +124,12 @@ def _probe_device_once(timeout_s: int) -> str | None:
         return None
     except (subprocess.TimeoutExpired, subprocess.CalledProcessError) as e:
         tail = (e.stderr or b"").decode(errors="replace").strip().splitlines()[-3:]
-        return f"{e}\n" + "\n".join(tail)
+        return {
+            "reason": "timeout" if isinstance(e, subprocess.TimeoutExpired)
+            else "error",
+            "timeout_s": timeout_s,
+            "detail": " | ".join(t.strip() for t in tail if t.strip())[:500],
+        }
 
 
 def _probe_device() -> None:
@@ -151,10 +159,12 @@ def _probe_device() -> None:
         if time.monotonic() >= deadline:
             print(
                 f"device backend unreachable after {attempt} probes over "
-                f"{budget:.0f}s ({err}); falling back to persisted capture",
+                f"{budget:.0f}s ({err['reason']}: {err['detail']}); falling "
+                f"back to persisted capture",
                 file=sys.stderr,
             )
-            _emit_stale_capture(probe_error=str(err).splitlines()[0])
+            _emit_stale_capture(probe={**err, "attempts": attempt,
+                                       "budget_s": budget})
             raise SystemExit(3)  # only reached when no capture exists
         print(f"device probe {attempt} failed; retrying "
               f"({remaining:.0f}s left in budget)", file=sys.stderr)
@@ -185,12 +195,15 @@ def _latest_session_capture() -> tuple[pathlib.Path, dict] | None:
     return best
 
 
-def _emit_stale_capture(probe_error: str) -> None:
+def _emit_stale_capture(probe: dict) -> None:
     """Degrade to the last persisted capture instead of a null record.
 
     Matches the reference harness's contract that a bench invocation always
     yields a record (`rust/benchmarks/tpch/src/main.rs:117-183`); the
-    ``stale`` marker keeps provenance honest.
+    ``stale`` marker plus the structured ``probe`` record (reason/timeout_s/
+    detail/attempts/budget_s) keep provenance honest and machine-readable —
+    a raw exception string forced every consumer to regex out WHY the
+    capture went stale.
     """
     found = _latest_session_capture()
     if found is None:
@@ -207,7 +220,7 @@ def _emit_stale_capture(probe_error: str) -> None:
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime(path.stat().st_mtime)),
         "capture_file": str(path.relative_to(REPO)) if path.is_relative_to(REPO)
         else str(path),
-        "probe_error": probe_error,
+        "probe": probe,
     }
     print(json.dumps(out))
     raise SystemExit(0)
@@ -227,6 +240,41 @@ def _persist_capture(result: dict) -> None:
             json.dumps(payload, indent=1) + "\n")
     except OSError as e:
         print(f"[persist] failed: {e}", file=sys.stderr)
+
+
+def _per_query(rb: dict | None, iters: int) -> dict | None:
+    """Normalize a timed-loop readback snapshot to per-query numbers (every
+    iteration does identical work, so the totals divide evenly). When they
+    ever don't (a mid-loop decline or cache eviction changed the work),
+    report the RAW totals flagged per_query=false so a consumer comparing
+    readback_rows against `limit` can tell the difference."""
+    if rb is None:
+        return rb
+    if iters > 1 and any(v % iters for v in rb.values()):
+        return {**rb, "per_query": False}
+    return {**{k: v // max(iters, 1) for k, v in rb.items()},
+            "per_query": True}
+
+
+def _readback_snapshot() -> dict | None:
+    """Drain the result-readback accumulator (ops/runtime.py): rows/bytes
+    transferred device->host for aggregate results since the last drain.
+    The fused Sort+Limit epilogue shrinks these to O(limit); the pre-fusion
+    full-column readback reports every group. None when no device readback
+    ran (declined or host backend)."""
+    try:
+        from ballista_tpu.ops.runtime import readback_stats
+
+        s = readback_stats(reset=True)
+    except Exception:
+        return None
+    if not s.get("readbacks"):
+        return None
+    return {
+        "readbacks": s["readbacks"],
+        "readback_rows": s["rows"],
+        "readback_bytes": s["bytes"],
+    }
 
 
 def _ingest_snapshot() -> dict | None:
@@ -265,7 +313,9 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         _ingest_snapshot()  # drain: attribute prepares to THIS config
         run_once("tpu", sql, sf)  # warmup: compile + caches
         ingest = _ingest_snapshot()  # fresh prepares happen at warmup
+        _readback_snapshot()  # drain: attribute readbacks to the timed runs
         t = min(run_once("tpu", sql, sf) for _ in range(iters))
+        readback = _per_query(_readback_snapshot(), iters)
         run_once("cpu", sql, sf)
         c = min(run_once("cpu", sql, sf) for _ in range(iters))
     except Exception as e:
@@ -283,6 +333,13 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         print(f"[ingest] {name} sf={sf}: scan={ingest['scan_s']}s "
               f"encode={ingest['encode_s']}s upload={ingest['upload_s']}s "
               f"wall={ingest['wall_s']}s overlap={ingest['overlap_frac']}",
+              file=sys.stderr)
+    if readback is not None:
+        row["readback"] = readback
+        unit = "per query" if readback.get("per_query") else "TOTALS (uneven loop)"
+        print(f"[readback] {name} sf={sf}: rows={readback['readback_rows']} "
+              f"bytes={readback['readback_bytes']} "
+              f"transfers={readback['readbacks']} ({unit})",
               file=sys.stderr)
     print(f"[config] {name} sf={sf}: tpu={row['tpu_ms']}ms "
           f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x", file=sys.stderr)
@@ -315,11 +372,21 @@ def _taxi_rows() -> list[dict]:
                 if table not in ctx.tables:
                     ctx.register_parquet(table, str(d / "trips"))
             run_once("tpu", sql, 1.0)
+            _readback_snapshot()  # drain: attribute to the timed runs
             t = min(run_once("tpu", sql, 1.0) for _ in range(2))
+            readback = _per_query(_readback_snapshot(), 2)
             run_once("cpu", sql, 1.0)
             c = min(run_once("cpu", sql, 1.0) for _ in range(2))
             row = {"name": label, "sf": 1.0, "tpu_ms": round(t * 1000, 1),
                    "cpu_ms": round(c * 1000, 1), "speedup": round(c / t, 2)}
+            if readback is not None:
+                row["readback"] = readback
+                unit = ("per query" if readback.get("per_query")
+                        else "TOTALS (uneven loop)")
+                print(f"[readback] {label}: rows={readback['readback_rows']} "
+                      f"bytes={readback['readback_bytes']} "
+                      f"transfers={readback['readbacks']} ({unit})",
+                      file=sys.stderr)
             print(f"[config] {label}: tpu={row['tpu_ms']}ms "
                   f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x",
                   file=sys.stderr)
@@ -343,7 +410,9 @@ def main() -> None:
     _ingest_snapshot()  # drain
     run_once("tpu", q1)
     headline_ingest = _ingest_snapshot()
+    _readback_snapshot()  # drain
     tpu_dt = min(run_once("tpu", q1) for _ in range(3))
+    headline_readback = _per_query(_readback_snapshot(), 3)
     run_once("cpu", q1)
     cpu_dt = min(run_once("cpu", q1) for _ in range(3))
 
@@ -387,6 +456,8 @@ def main() -> None:
     }
     if headline_ingest is not None:
         result["ingest"] = headline_ingest
+    if headline_readback is not None:
+        result["readback"] = headline_readback
     try:
         import jax
 

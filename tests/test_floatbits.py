@@ -1,0 +1,135 @@
+"""Property tests for the order-preserving IEEE-754 <-> int bijection
+(ops/floatbits.py): monotone total order over randomized samples including
+-0.0, subnormals and ±inf; exact round-trip; the f64 (hi, lo) int32 plane
+split's lexicographic order; and the in-program jnp variants matching the
+numpy reference bit-for-bit."""
+
+import numpy as np
+import pytest
+
+from ballista_tpu.ops import floatbits
+
+
+def _samples(dtype, rng, n=4096):
+    """Adversarial float sample: full-range bit patterns (excluding NaN),
+    plus the documented edge cases."""
+    info = np.finfo(dtype)
+    itype = np.int32 if dtype == np.float32 else np.int64
+    bits = rng.integers(np.iinfo(itype).min, np.iinfo(itype).max, n,
+                        dtype=itype)
+    vals = bits.view(dtype)
+    vals = vals[~np.isnan(vals)]
+    edge = np.array(
+        [0.0, -0.0, np.inf, -np.inf, info.tiny, -info.tiny,
+         info.smallest_subnormal, -info.smallest_subnormal,
+         info.max, info.min, info.eps, 1.0, -1.0],
+        dtype=dtype,
+    )
+    uniform = rng.uniform(-1e6, 1e6, n).astype(dtype)
+    return np.concatenate([vals, edge, uniform])
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_monotone_total_order(dtype, seed):
+    rng = np.random.default_rng(100 + seed)
+    x = _samples(dtype, rng)
+    enc = floatbits.f32_to_i32 if dtype == np.float32 else floatbits.f64_to_i64
+    k = enc(x)
+    # pairwise over a shuffled comparison: x < y <=> key(x) < key(y);
+    # x == y (±0 collapse) <=> key equality
+    y = rng.permutation(x)
+    ky = enc(y)
+    np.testing.assert_array_equal(x < y, k < ky)
+    np.testing.assert_array_equal(x == y, k == ky)
+    # argsort by key IS a float sort (stability irrelevant: keys are total)
+    order = np.argsort(k, kind="stable")
+    assert not np.any(np.diff(x[order]) < 0)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_round_trip_bit_exact(dtype):
+    rng = np.random.default_rng(7)
+    x = _samples(dtype, rng)
+    enc, dec = (
+        (floatbits.f32_to_i32, floatbits.i32_to_f32)
+        if dtype == np.float32
+        else (floatbits.f64_to_i64, floatbits.i64_to_f64)
+    )
+    back = dec(enc(x))
+    itype = np.int32 if dtype == np.float32 else np.int64
+    xb, bb = x.view(itype), back.view(itype)
+    negzero = x == 0.0
+    # every value except -0.0 round-trips to the identical bit pattern
+    np.testing.assert_array_equal(xb[~negzero], bb[~negzero])
+    # the documented collapse: both zeros decode as +0.0
+    assert np.all(bb[negzero] == 0)
+    # ±0 collapse to key 0
+    assert np.all(enc(np.array([0.0, -0.0], dtype=dtype)) == 0)
+
+
+def test_nan_keys_sort_outside_infinities():
+    """+NaN keys above +inf, -NaN keys below -inf (documented policy; the
+    aggregate path declines NaN inputs before keys are built)."""
+    pnan = np.array([np.nan], dtype=np.float32)
+    nnan = -pnan
+    inf = np.array([np.inf], dtype=np.float32)
+    assert floatbits.f32_to_i32(pnan)[0] > floatbits.f32_to_i32(inf)[0]
+    assert floatbits.f32_to_i32(nnan)[0] < floatbits.f32_to_i32(-inf)[0]
+    p64 = np.array([np.nan], dtype=np.float64)
+    i64 = np.array([np.inf], dtype=np.float64)
+    assert floatbits.f64_to_i64(p64)[0] > floatbits.f64_to_i64(i64)[0]
+    assert floatbits.f64_to_i64(-p64)[0] < floatbits.f64_to_i64(-i64)[0]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_plane_split_lexicographic_order(seed):
+    """(hi, lo) int32 planes: lexicographic signed order == i64 key order,
+    and planes_to_i64 inverts exactly (also from int64-widened planes, the
+    form device readbacks arrive in)."""
+    rng = np.random.default_rng(300 + seed)
+    x = _samples(np.float64, rng, n=2048)
+    k = floatbits.f64_to_i64(x)
+    hi, lo = floatbits.i64_to_planes(k)
+    assert hi.dtype == np.int32 and lo.dtype == np.int32
+    np.testing.assert_array_equal(floatbits.planes_to_i64(hi, lo), k)
+    np.testing.assert_array_equal(
+        floatbits.planes_to_i64(hi.astype(np.int64), lo.astype(np.int64)), k
+    )
+    perm = rng.permutation(len(k))
+    lex_lt = (hi < hi[perm]) | ((hi == hi[perm]) & (lo < lo[perm]))
+    np.testing.assert_array_equal(lex_lt, k < k[perm])
+
+
+def test_jnp_variants_match_numpy():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    x = _samples(np.float32, rng, n=1024)
+    k = floatbits.f32_to_i32(x)
+    kj = np.asarray(floatbits.jnp_f32_to_i32(jnp.asarray(x)))
+    np.testing.assert_array_equal(k, kj)
+    xj = np.asarray(floatbits.jnp_i32_to_f32(jnp.asarray(k)))
+    np.testing.assert_array_equal(floatbits.i32_to_f32(k).view(np.int32),
+                                  xj.view(np.int32))
+
+
+def test_minmax_equals_float_extrema():
+    """The whole point: integer min/max over keys inverts to the bit-exact
+    float min/max (negative-heavy, subnormal and ±0 mixes included)."""
+    rng = np.random.default_rng(13)
+    for dtype, enc, dec in (
+        (np.float32, floatbits.f32_to_i32, floatbits.i32_to_f32),
+        (np.float64, floatbits.f64_to_i64, floatbits.i64_to_f64),
+    ):
+        x = _samples(dtype, rng)
+        x = x[np.isfinite(x) | np.isinf(x)]
+        k = enc(x)
+        got_min = dec(np.array([k.min()], dtype=k.dtype))[0]
+        got_max = dec(np.array([k.max()], dtype=k.dtype))[0]
+        assert got_min == x.min() and got_max == x.max()
+        # bit-identical too (modulo the -0.0 collapse)
+        if x.min() != 0.0:
+            itype = np.int32 if dtype == np.float32 else np.int64
+            assert np.array([got_min], dtype=dtype).view(itype)[0] == \
+                np.array([x.min()], dtype=dtype).view(itype)[0]

@@ -175,8 +175,11 @@ def test_bench_stale_capture_fallback(tmp_path, monkeypatch, capsys):
     bench = importlib.import_module("bench")
     monkeypatch.setattr(bench, "RESULTS_DIR", tmp_path)
 
+    probe = {"reason": "timeout", "timeout_s": 30, "detail": "dead relay",
+             "attempts": 3, "budget_s": 1200.0}
+
     # no captures at all -> returns without exiting (caller then exits 3)
-    bench._emit_stale_capture(probe_error="dead relay")
+    bench._emit_stale_capture(probe=probe)
     assert capsys.readouterr().out == ""
 
     old = {"metric": "m", "value": 1.0, "unit": "u", "vs_baseline": 1.0,
@@ -204,14 +207,48 @@ def test_bench_stale_capture_fallback(tmp_path, monkeypatch, capsys):
     assert path == p_new and d["value"] == 2.0
 
     with pytest.raises(SystemExit) as ei:
-        bench._emit_stale_capture(probe_error="dead relay")
+        bench._emit_stale_capture(probe=probe)
     assert ei.value.code == 0
     out = json.loads(capsys.readouterr().out)
     assert out["stale"] is True
     assert out["value"] == 2.0
-    assert out["probe_error"] == "dead relay"
+    # structured probe record: reason/timeout_s/detail survive verbatim
+    assert out["probe"] == probe
     assert out["configs"] == new["configs"]
     assert "captured_at" in out and "capture_file" in out
+
+
+def test_bench_probe_failure_is_structured(monkeypatch):
+    """A hung jax.devices() probe (the 30s timeout) must surface as a
+    structured {reason: timeout, timeout_s, detail} record, not a raw
+    exception string glued into the JSON."""
+    import importlib
+    import subprocess
+
+    bench = importlib.import_module("bench")
+
+    def fake_run(cmd, timeout, check, capture_output):
+        raise subprocess.TimeoutExpired(cmd, timeout,
+                                        stderr=b"relay hang\ntail line")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    err = bench._probe_device_once(timeout_s=30)
+    assert err["reason"] == "timeout" and err["timeout_s"] == 30
+    assert "tail line" in err["detail"]
+
+    def fake_run_crash(cmd, timeout, check, capture_output):
+        raise subprocess.CalledProcessError(1, cmd, stderr=b"no backend")
+
+    monkeypatch.setattr(subprocess, "run", fake_run_crash)
+    err = bench._probe_device_once(timeout_s=30)
+    assert err["reason"] == "error" and "no backend" in err["detail"]
+
+    # healthy probe -> None (the exit-0 main path)
+    def fake_run_ok(cmd, timeout, check, capture_output):
+        return subprocess.CompletedProcess(cmd, 0, stdout=b"[CpuDevice(0)]")
+
+    monkeypatch.setattr(subprocess, "run", fake_run_ok)
+    assert bench._probe_device_once(timeout_s=30) is None
 
 
 def test_bench_persist_capture(tmp_path, monkeypatch):

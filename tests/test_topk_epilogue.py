@@ -1,0 +1,308 @@
+"""Device-resident Sort+Limit epilogue fusion (ops/stage.py::_run_topk over
+the planner's _topk_pushdown annotation): the device must read back exactly
+`limit` rows — bit-identical to what the full readback + host sort+limit
+would emit — and fall back gracefully whenever it cannot guarantee that
+(boundary ties under un-fused tie-breakers, ineligible key kinds, covers
+that would blow the padding budget)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.ops import kernels
+from ballista_tpu.ops.runtime import readback_stats, reset_residency
+
+
+def _fresh():
+    kernels._stage_cache.clear()
+    kernels._stage_cache_pins.clear()
+    kernels._stage_latest.clear()
+    reset_residency()
+    readback_stats(reset=True)
+
+
+def _ctxs(tmp_path, table, name="t"):
+    path = str(tmp_path / f"{name}.parquet")
+    pq.write_table(table, path)
+    out = {}
+    for backend in ("tpu", "cpu"):
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        ctx.register_parquet(name, path)
+        out[backend] = ctx
+    return out
+
+
+def _table(n=30_000, n_groups=2500, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "g": pa.array(rng.integers(0, n_groups, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+            "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+        }
+    )
+
+
+def test_fused_topk_reads_back_limit_rows(tmp_path):
+    """The headline contract: selection identical to host sort+limit, d2h
+    readback shrunk from every group to `limit` rows."""
+    _fresh()
+    ctxs = _ctxs(tmp_path, _table())
+    sql = ("select g, sum(q) s, min(v) mn from t group by g "
+           "order by s desc, g limit 11")
+    got = ctxs["tpu"].sql(sql).collect()
+    rb = readback_stats(reset=True)
+    want = ctxs["cpu"].sql(sql).collect()
+    assert got.to_pydict() == want.to_pydict()
+    assert rb["rows"] == 11, rb  # NOT the ~2500 groups
+    assert rb["readbacks"] == 1
+
+
+def test_fused_topk_ascending_and_float_score(tmp_path):
+    """Ascending order and an f32 float-sum score (the taxi shape): the
+    selection must equal the host sort over the device's own aggregate
+    output — exercised by re-running the same query with fusion disabled."""
+    _fresh()
+    ctxs = _ctxs(tmp_path, _table(seed=3))
+    sql = ("select g, sum(v) rev from t group by g "
+           "order by rev limit 9")
+    fused = ctxs["tpu"].sql(sql).collect()
+    rb = readback_stats(reset=True)
+    assert rb["rows"] == 9
+    # fusion off (computed sort key defeats the annotation; rev + 0 orders
+    # identically): the full device output through the host Sort must pick
+    # the same rows
+    _fresh()
+    unfused = ctxs["tpu"].sql(
+        "select g, sum(v) rev from t group by g order by rev + 0 limit 9"
+    ).collect()
+    rb2 = readback_stats(reset=True)
+    fd, ud = fused.to_pydict(), unfused.to_pydict()
+    assert fd["g"] == ud["g"]  # identical selection
+    # the cover layout regroups the f32 accumulation (one chunk per group),
+    # so float sums agree at the documented device tolerance, not bit-level
+    np.testing.assert_allclose(fd["rev"], ud["rev"], rtol=1e-4)
+    assert rb2["rows"] > 9  # the floor the fusion removes
+
+
+def test_multi_key_lexicographic(tmp_path):
+    """Two fused aggregate sort keys (desc then asc) + trailing group key:
+    selection matches the host's lexicographic order exactly."""
+    _fresh()
+    rng = np.random.default_rng(5)
+    n = 20_000
+    # coarse sums force many first-key ties so the second key decides
+    t = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 700, n), type=pa.int64()),
+            "a": pa.array(rng.integers(0, 3, n), type=pa.int64()),
+            "b": pa.array(rng.integers(1, 100, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(0, 10, n), 2)),
+        }
+    )
+    ctxs = _ctxs(tmp_path, t)
+    sql = ("select g, sum(a) sa, min(b) mb from t group by g "
+           "order by sa desc, mb, g limit 13")
+    got = ctxs["tpu"].sql(sql).collect()
+    want = ctxs["cpu"].sql(sql).collect()
+    assert got.to_pydict() == want.to_pydict()
+
+
+def test_boundary_tie_falls_back_to_host_order(tmp_path):
+    """k-th and (k+1)-th groups TIE on every fused lane while an un-fused
+    trailing key (g desc) orders them AGAINST the device's group-index
+    tie-break: the epilogue must detect the boundary tie and fall back to
+    the full readback so the host decides."""
+    _fresh()
+    # sums: group i gets sum i // 2 -> every adjacent pair ties
+    rows_g, rows_q = [], []
+    for g in range(40):
+        rows_g.extend([g] * 4)
+        score = g // 2
+        rows_q.extend([score, 0, 0, 0])
+    t = pa.table(
+        {
+            "g": pa.array(rows_g, type=pa.int64()),
+            "q": pa.array(rows_q, type=pa.int64()),
+        }
+    )
+    ctxs = _ctxs(tmp_path, t)
+    # limit 3 boundary lands INSIDE a tied pair; 'g desc' prefers the
+    # HIGHER group id, the fused iota lane would prefer the lower
+    sql = ("select g, sum(q) s from t group by g "
+           "order by s desc, g desc limit 3")
+    got = ctxs["tpu"].sql(sql).collect()
+    want = ctxs["cpu"].sql(sql).collect()
+    assert got.to_pydict() == want.to_pydict()
+    assert got.to_pydict()["g"] == [39, 38, 37]
+
+
+def test_ineligible_key_kind_runs_unfused(tmp_path):
+    """avg finalizes to a ratio of its state rows — ranking the sum row
+    would order by the wrong quantity. The spec must reject it and the
+    normal full-readback path must serve the query correctly."""
+    _fresh()
+    ctxs = _ctxs(tmp_path, _table(seed=7))
+    sql = ("select g, avg(q) a from t group by g "
+           "order by a desc, g limit 5")
+    got = ctxs["tpu"].sql(sql).collect()
+    rb = readback_stats(reset=True)
+    want = ctxs["cpu"].sql(sql).collect()
+    assert got.to_pydict()["g"] == want.to_pydict()["g"]
+    np.testing.assert_allclose(got.to_pydict()["a"], want.to_pydict()["a"],
+                               rtol=1e-4)
+    assert rb["rows"] > 5  # full readback: fusion never engaged
+
+
+def test_limit_wider_than_groups_runs_unfused(tmp_path):
+    """k >= group count: selection cannot exclude anything, fusion stays
+    off, results unchanged."""
+    _fresh()
+    ctxs = _ctxs(tmp_path, _table(n=2000, n_groups=8, seed=9))
+    sql = ("select g, sum(q) s from t group by g "
+           "order by s desc, g limit 50")
+    got = ctxs["tpu"].sql(sql).collect()
+    want = ctxs["cpu"].sql(sql).collect()
+    assert got.to_pydict() == want.to_pydict()
+    assert got.num_rows == 8
+
+
+def test_topk_cover_declines_on_skew():
+    """_topk_cover_L1: a run longer than TOPK_MAX_L1 (or a cover whose
+    padding blows past ~4x the real rows) disables fusion for the
+    partition — the default chunking must take over."""
+    from ballista_tpu.ops.stage import TOPK_MAX_L1, _topk_cover_L1
+
+    rng = np.random.default_rng(11)
+    even = rng.integers(0, 64, 100_000).astype(np.int64)
+    even.sort()
+    assert _topk_cover_L1(even, 64) is not None
+    skew = np.zeros(TOPK_MAX_L1 + 1, dtype=np.int64)  # one monster run
+    assert _topk_cover_L1(skew, 1) is None
+    # pathological padding: 3 tiny groups + one 4097-run -> cover pads
+    # 3 * 8192 + 8192 slots for ~4100 rows, past the 4x budget... but under
+    # the 1<<22 floor the small absolute size is accepted
+    mixed = np.concatenate([np.repeat(np.arange(3), 1), np.full(4097, 3)])
+    assert _topk_cover_L1(np.sort(mixed), 4) is not None
+    # scaled up past the absolute floor it declines
+    big = np.concatenate(
+        [np.repeat(np.arange(4000), 1), np.full(TOPK_MAX_L1, 4000)]
+    ).astype(np.int64)
+    assert _topk_cover_L1(np.sort(big), 4001) is None
+
+
+def test_exact_float_minmax_epilogue_composes(tmp_path):
+    """Bijected float MIN/MAX as fused SORT KEYS: full-mantissa doubles
+    rank bit-exactly (no f32 collapse), and the returned extrema are the
+    stored values bit-for-bit."""
+    _fresh()
+    rng = np.random.default_rng(13)
+    n = 25_000
+    v = rng.uniform(-1e9, 1e9, n) + rng.uniform(0, 1e-6, n)
+    v[::173] = -0.0
+    t = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 900, n), type=pa.int64()),
+            "v": pa.array(v),
+        }
+    )
+    ctxs = _ctxs(tmp_path, t)
+    sql = ("select g, min(v) mn, max(v) mx from t group by g "
+           "order by mn, g limit 17")
+    got = ctxs["tpu"].sql(sql).collect()
+    rb = readback_stats(reset=True)
+    want = ctxs["cpu"].sql(sql).collect()
+    gd, wd = got.to_pydict(), want.to_pydict()
+    assert gd["g"] == wd["g"]
+    for c in ("mn", "mx"):
+        for a, b in zip(gd[c], wd[c]):
+            assert (a == b == 0.0) or (
+                np.float64(a).tobytes() == np.float64(b).tobytes()
+            ), (c, a, b)
+    assert rb["rows"] == 17
+
+
+def _skewed_table(seed=17, n_small=3000, monster=2049):
+    """One monster group makes the one-chunk cover decline (its L1 would
+    pad n_groups * L1 past the budget), forcing the in-program fold
+    variant."""
+    rng = np.random.default_rng(seed)
+    g = np.concatenate([np.arange(n_small), np.full(monster, n_small)])
+    return pa.table(
+        {
+            "g": pa.array(g, type=pa.int64()),
+            "v": pa.array(rng.uniform(-1e9, 1e9, len(g))
+                          + rng.uniform(0, 1e-6, len(g))),
+            "q": pa.array(rng.integers(1, 50, len(g)), type=pa.int64()),
+        }
+    )
+
+
+def test_skewed_cover_folds_in_program(tmp_path):
+    """q10's shape in miniature: the fused epilogue must still read back
+    `limit` rows by segment-folding chunk partials to group states on
+    device, bit-exact for min/max (incl. the f64-bijected pair fold)."""
+    _fresh()
+    from ballista_tpu.ops.stage import _topk_cover_L1
+
+    t = _skewed_table()
+    codes = t.column("g").to_numpy().astype(np.int64)
+    assert _topk_cover_L1(np.sort(codes), 3001) is None  # fold path it is
+    ctxs = _ctxs(tmp_path, t)
+    sql = ("select g, min(v) mn, max(v) mx, count(*) c from t group by g "
+           "order by mn, g limit 15")
+    got = ctxs["tpu"].sql(sql).collect()
+    rb = readback_stats(reset=True)
+    want = ctxs["cpu"].sql(sql).collect()
+    gd, wd = got.to_pydict(), want.to_pydict()
+    assert gd["g"] == wd["g"] and gd["c"] == wd["c"]
+    for c in ("mn", "mx"):
+        for a, b in zip(gd[c], wd[c]):
+            assert np.float64(a).tobytes() == np.float64(b).tobytes(), (c, a, b)
+    assert rb["rows"] == 15, rb
+
+
+def test_skewed_int_sum_keeps_full_readback(tmp_path):
+    """The fold variant sums int32 in-program where the host fold widens
+    to int64 — int-exact SUM aggregates must disable it (full readback,
+    exact as ever) rather than risk overflow."""
+    _fresh()
+    t = _skewed_table(seed=19)
+    ctxs = _ctxs(tmp_path, t)
+    sql = ("select g, sum(q) s from t group by g "
+           "order by s desc, g limit 6")
+    got = ctxs["tpu"].sql(sql).collect()
+    rb = readback_stats(reset=True)
+    want = ctxs["cpu"].sql(sql).collect()
+    assert got.to_pydict() == want.to_pydict()
+    assert rb["rows"] > 6  # fusion declined, not wrong
+
+
+def test_too_many_key_lanes_runs_unfused(tmp_path):
+    """f64-bijected keys spend TWO int32 lanes each; past TOPK_MAX_KEY_LANES
+    the spec declines ("unsupported multi-key widths") and the full
+    readback serves the query."""
+    _fresh()
+    rng = np.random.default_rng(23)
+    n = 8000
+    # small G: with the spec declined the stage runs the UNROLLED core,
+    # whose per-group python loop makes XLA compile time scale with
+    # G x aggregates — the lane-cap decline itself is G-independent
+    cols = {"g": pa.array(rng.integers(0, 24, n), type=pa.int64())}
+    for i in range(4):
+        cols[f"v{i}"] = pa.array(rng.uniform(-1e9, 1e9, n))
+    ctxs = _ctxs(tmp_path, pa.table(cols))
+    aggs = ", ".join(f"min(v{i}) m{i}" for i in range(4))
+    order = ", ".join(f"m{i}" for i in range(4))  # 4 x f64 = 8 lanes > 6
+    sql = (f"select g, {aggs} from t group by g "
+           f"order by {order}, g limit 5")
+    got = ctxs["tpu"].sql(sql).collect()
+    rb = readback_stats(reset=True)
+    want = ctxs["cpu"].sql(sql).collect()
+    assert got.to_pydict() == want.to_pydict()
+    assert rb["rows"] > 5  # spec declined: full readback, still exact
