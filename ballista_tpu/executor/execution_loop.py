@@ -52,21 +52,29 @@ class PollLoop:
         self._available = threading.Semaphore(concurrent_tasks)
         self._finished: "queue.Queue[pb.TaskStatus]" = queue.Queue()
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        # lifecycle state shared between the poll thread and start()/stop()
+        # callers (the queue/semaphore/event above are internally
+        # thread-safe and need no extra guard)
+        self._mu = threading.Lock()
+        self._thread: Optional[threading.Thread] = None  # guarded-by: self._mu
         # shuffle-dir GC: the reference never collects work dirs
         # (SURVEY §5 "Nothing garbage-collects work dirs")
         self.shuffle_ttl_seconds = 3600.0
-        self._last_gc = time.time()
+        self._last_gc = time.time()  # guarded-by: self._mu
 
     # ------------------------------------------------------------------
     def start(self) -> None:
-        self._thread = threading.Thread(target=self.run, daemon=True)
-        self._thread.start()
+        t = threading.Thread(target=self.run, daemon=True)
+        with self._mu:
+            self._thread = t
+        t.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=5)
+        with self._mu:
+            t = self._thread
+        if t:
+            t.join(timeout=5)
 
     def run(self) -> None:
         while not self._stop.is_set():
@@ -75,8 +83,11 @@ class PollLoop:
             except Exception as e:
                 # repeated poll failure only warns (ref execution_loop.rs:70-72)
                 log.warning("poll failed: %s", e)
-            if time.time() - self._last_gc > 60:
-                self._last_gc = time.time()
+            with self._mu:
+                gc_due = time.time() - self._last_gc > 60
+                if gc_due:
+                    self._last_gc = time.time()
+            if gc_due:
                 try:
                     self.gc_work_dir()
                 except Exception as e:

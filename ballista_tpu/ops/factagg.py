@@ -142,8 +142,13 @@ class FactAggregateStage:
             return None
         try:
             return FactAggregateStage(agg)
-        except UnsupportedOnDevice:
-            return None
+        except UnsupportedOnDevice as e:
+            from ballista_tpu.ops.kernels import step_aside
+
+            # not the end of the ladder: hash_aggregate tries the mapped
+            # rewrite next (the query may still run fully on device), but
+            # the reason why factagg stepped aside must stay observable
+            return step_aside(f"factagg admission: {e}")
 
     def __init__(self, agg) -> None:
         from ballista_tpu.logical.plan import JoinType

@@ -18,7 +18,7 @@ from typing import Optional, Tuple
 import numpy as np
 import pyarrow as pa
 
-from ballista_tpu.ops.runtime import bucket_rows, pad_to
+from ballista_tpu.ops.runtime import bucket_rows, pad_to, readback
 
 
 @functools.lru_cache(maxsize=None)
@@ -66,7 +66,7 @@ def device_join_indices(
     # null probe keys (-1) must not match; -1 would binary-search below all
     # valid codes and compare unequal, which is already a non-match
     p = jnp.asarray(pad_to(probe_codes.astype(np.int32), bucket_rows(np_, 16), -1))
-    out = np.asarray(_kernel()(b, p, nb))[:np_]
+    out = readback(_kernel()(b, p, nb))[:np_]
     return out, out >= 0
 
 

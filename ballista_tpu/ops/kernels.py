@@ -5,11 +5,19 @@ filter_batch is a per-batch lowering used when a filter runs outside a
 fusable aggregate pipeline; it returns None (host fallback) for shapes the
 device path doesn't support. Projections have no stand-alone device path —
 they only pay off fused into a stage (FusedAggregateStage / FactAggregateStage).
+
+This module also owns the CANONICAL DECLINE HELPERS (`decline`,
+`host_fallback`): device paths bail to host only through
+`raise UnsupportedOnDevice("<reason>")` or these — never a silent
+`return None` or an ad-hoc exception — so every decline carries a reason
+and the kernels ladder stays enumerable. Enforced by dev/analysis's
+decline-discipline pass.
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -22,20 +30,54 @@ from ballista_tpu.ops.runtime import (
     bucket_rows,
     column_to_numpy,
     pad_to,
+    readback,
 )
 
-_stage_cache: Dict[str, object] = {}
-# pins each cached stage's table source so its id() (part of the cache key
-# for memory scans) can never be recycled by a different object
-_stage_cache_pins: Dict[str, object] = {}
-# stable plan identity -> the latest full (mtime-bearing) cache key, so a
-# rewritten file's superseded entry can be evicted and its reservations freed
-_stage_latest: Dict[str, str] = {}
+
+def decline(reason: str):
+    """Canonical raising decline: identical to raising UnsupportedOnDevice
+    directly, kept as the named entry point for the ladder."""
+    raise UnsupportedOnDevice(reason)
+
+
+def host_fallback(reason: str) -> None:
+    """Canonical Optional-sentinel decline: logs + counts the reason, then
+    returns the None the dispatcher maps to the host Arrow path. Use this
+    instead of a bare `return None` inside UnsupportedOnDevice handlers so
+    declines stay observable (tracing counter + debug log)."""
+    from ballista_tpu.utils import tracing
+
+    tracing.incr("device.host_fallback")
+    logging.getLogger("ballista.tpu").debug("host fallback: %s", reason)
+    return None
+
+
+def step_aside(reason: str) -> None:
+    """Canonical MID-LADDER decline: one admission path steps aside but the
+    dispatcher tries the next rung (e.g. factagg -> mapped rewrite), so the
+    query may still run fully on device. Counted separately from
+    host_fallback — conflating them would make the device path look
+    disengaged on queries that ran on-chip."""
+    from ballista_tpu.utils import tracing
+
+    tracing.incr("device.step_aside")
+    logging.getLogger("ballista.tpu").debug("ladder step-aside: %s", reason)
+    return None
+
 # executor task threads run concurrently: lookup/evict/insert must be one
-# atomic section or two threads can each build (and pin) the same stage
+# atomic section or two threads can each build (and pin) the same stage.
+# (Tests reach in to clear these between cases — cross-file accesses are
+# outside the file-scoped guarded-by check by design.)
 import threading as _threading
 
 _stage_cache_lock = _threading.Lock()
+_stage_cache: Dict[str, object] = {}  # guarded-by: _stage_cache_lock
+# pins each cached stage's table source so its id() (part of the cache key
+# for memory scans) can never be recycled by a different object
+_stage_cache_pins: Dict[str, object] = {}  # guarded-by: _stage_cache_lock
+# stable plan identity -> the latest full (mtime-bearing) cache key, so a
+# rewritten file's superseded entry can be evicted and its reservations freed
+_stage_latest: Dict[str, str] = {}  # guarded-by: _stage_cache_lock
 _filter_cache: Dict[tuple, object] = {}
 _cache_configured = False
 
@@ -211,9 +253,9 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         # HBM-budget reservations before dropping the stage. Log WHY once —
         # a silent decline (e.g. tiles just past the HBM budget) reads as
         # "device path ran" in benchmarks when it did not.
-        import logging
         import sys
 
+        reason = f"stage permanently declined: {sys.exc_info()[1]}"
         logging.getLogger("ballista.tpu").warning(
             "device stage permanently declined to host: %s", sys.exc_info()[1]
         )
@@ -222,7 +264,7 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         release_stage_residency(stage)
         with _stage_cache_lock:
             _stage_cache[key] = False
-        return None
+        return host_fallback(reason)
 
 
 def _compile_predicate(predicate, schema: pa.Schema):
@@ -237,7 +279,7 @@ def _compile_predicate(predicate, schema: pa.Schema):
         compiler = ExprCompiler(schema, dicts)
         cv = compiler.compile(predicate)
         if cv.kind != "bool":
-            raise UnsupportedOnDevice("non-boolean predicate")
+            decline("non-boolean predicate")
         import jax
 
         from ballista_tpu.ops.jaxexpr import predicate_fn
@@ -273,10 +315,11 @@ def filter_batch(batch: pa.RecordBatch, predicate) -> Optional[pa.RecordBatch]:
             npcol = column_to_numpy(batch.column(idx), dtype, d)
             fill = False if npcol.dtype == np.bool_ else 0
             cols[idx] = jnp.asarray(pad_to(npcol, bucket, fill))
-    except UnsupportedOnDevice:
-        return None
+    except UnsupportedOnDevice as e:
+        return host_fallback(f"filter batch lowering: {e}")
     aux = [jnp.asarray(a) for a in compiler.build_aux()]
-    mask = np.asarray(run(cols, aux))[:n]
+    # the full boolean mask rides d2h once per batch — account for it
+    mask = readback(run(cols, aux))[:n]
     return batch.filter(pa.array(mask))
 
 

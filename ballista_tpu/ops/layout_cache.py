@@ -88,7 +88,7 @@ def _dir_bytes(base: str) -> int:
 import threading as _threading
 
 _size_lock = _threading.Lock()
-_size_cache: Dict[str, int] = {}
+_size_cache: Dict[str, int] = {}  # base dir -> bytes; guarded-by: _size_lock
 
 
 def _size_note(base: str, delta: int) -> None:

@@ -243,4 +243,6 @@ def grouped_aggregate(
         jnp.asarray(values.astype(np.float32)),
         jnp.asarray(mask),
     )
-    return np.asarray(out)
+    from ballista_tpu.ops.runtime import readback
+
+    return readback(out, rows=num_groups)  # [G, A]: the row axis leads

@@ -39,13 +39,14 @@ class ColumnDictionary:
     def __init__(self) -> None:
         import threading
 
-        self.values: Optional[pa.Array] = None  # accumulated distinct values
+        self.values: Optional[pa.Array] = None  # distinct values; guarded-by: self._lock
         self._lock = threading.Lock()
 
     def encode(self, arr: pa.Array) -> np.ndarray:
         with self._lock:
             return self._encode(arr)
 
+    # holds-lock: self._lock
     def _encode(self, arr: pa.Array) -> np.ndarray:
         """Encode an Arrow array to codes against this dictionary, extending
         it with novel values. Nulls -> -1."""
@@ -105,7 +106,8 @@ class ColumnDictionary:
             return int(idx.as_py())
 
     def __len__(self) -> int:
-        return 0 if self.values is None else len(self.values)
+        with self._lock:
+            return 0 if self.values is None else len(self.values)
 
 
 class ScanDictionaries:
@@ -130,10 +132,10 @@ import threading
 import time
 
 _res_lock = threading.Lock()
-_resident_bytes = 0
-_reservations: dict = {}  # token -> bytes
-_pinned: dict = {}  # token -> (stage, partition), for LRU eviction
-_last_used: dict = {}  # token -> monotonic time of last cached run
+_resident_bytes = 0  # guarded-by: _res_lock
+_reservations: dict = {}  # token -> bytes; guarded-by: _res_lock
+_pinned: dict = {}  # token -> (stage, partition), for LRU; guarded-by: _res_lock
+_last_used: dict = {}  # token -> monotonic last-run time; guarded-by: _res_lock
 
 
 def entry_device_bytes(obj) -> int:
@@ -207,9 +209,10 @@ _EVICT_COST_RATIO = 4
 # the same steady state first-come residency gave that pattern, while
 # sequential workloads (the bench / the 22-query suite) still evict freely
 _EVICT_COOLDOWN_S = 60.0
-_evicted_at: dict = {}  # id(stage) -> monotonic time of last eviction
+_evicted_at: dict = {}  # id(stage) -> last eviction time; guarded-by: _res_lock
 
 
+# holds-lock: _res_lock
 def _evict_lru_locked(requesting_stage, nbytes: int, budget: int) -> None:
     """Evict other stages' pinned partitions, oldest touch first, until
     `nbytes` fits. Caller holds _res_lock. The requesting stage's own
@@ -309,6 +312,7 @@ def fetch_arrays(arrs: list) -> list:
             chunk = idxs[lo:lo + 8]
             arity = 2 if len(chunk) <= 2 else (4 if len(chunk) <= 4 else 8)
             padded = chunk + [chunk[0]] * (arity - len(chunk))
+            # ballista-lint: disable=readback-discipline -- transport-layer batching: callers (stage.run) record the result-readback rows/bytes with aggregate semantics; recording here too would double-count
             stacked = np.asarray(_stack_jit(*[arrs[i] for i in padded]))
             for j, i in enumerate(chunk):
                 out[i] = stacked[j]
@@ -343,7 +347,8 @@ def release_stage_residency(stage) -> None:
 
 
 def resident_bytes() -> int:
-    return _resident_bytes
+    with _res_lock:
+        return _resident_bytes
 
 
 def reset_residency() -> None:
@@ -630,6 +635,7 @@ def pipelined_map(src, fn, workers: int, depth: int = 2, on_src_time=None):
 # end-to-end prepare. overlap_frac = 1 - wall / (scan + encode + upload):
 # 0 on the serial path, > 0 when the pipeline actually hid host work.
 _ingest_lock = threading.Lock()
+# guarded-by: _ingest_lock
 _ingest_totals = {
     "scan_s": 0.0, "encode_s": 0.0, "upload_s": 0.0, "wall_s": 0.0,
     "prepares": 0,
@@ -669,7 +675,7 @@ def ingest_stats(reset: bool = False) -> Dict[str, float]:
 # size. The fused Sort+Limit epilogue's whole point is to shrink these to
 # O(limit); readbacks is the transfer count.
 _readback_lock = threading.Lock()
-_readback_totals = {"rows": 0, "bytes": 0, "readbacks": 0}
+_readback_totals = {"rows": 0, "bytes": 0, "readbacks": 0}  # guarded-by: _readback_lock
 
 
 def record_readback(rows: int, nbytes: int) -> None:
@@ -677,6 +683,22 @@ def record_readback(rows: int, nbytes: int) -> None:
         _readback_totals["rows"] += int(rows)
         _readback_totals["bytes"] += int(nbytes)
         _readback_totals["readbacks"] += 1
+
+
+def readback(x, rows: Optional[int] = None) -> np.ndarray:
+    """Canonical device->host result materialization: np.asarray + the
+    readback accounting in one step. `rows` defaults to the trailing-axis
+    length (group/candidate count in the packed [R, G] result convention);
+    pass it explicitly when the row axis is not the trailing one. Every
+    device-path np.asarray of a compiled-program result must go through
+    here (or pair with record_readback) — enforced by
+    dev/analysis's readback-discipline pass."""
+    arr = np.asarray(x)
+    record_readback(
+        rows if rows is not None else (arr.shape[-1] if arr.ndim else 1),
+        arr.nbytes,
+    )
+    return arr
 
 
 def readback_stats(reset: bool = False) -> Dict[str, int]:

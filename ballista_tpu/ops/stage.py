@@ -1517,9 +1517,10 @@ class FusedAggregateStage:
 
     def _run_pallas_sorted(self, ent: dict, aux) -> pa.Table:
         from ballista_tpu.ops.pallas_kernels import sorted_grouped_sum
+        from ballista_tpu.ops.runtime import readback
 
         vals = self._pallas_masked_rows_step()(ent["cols"], aux, ent["row_valid"])
-        out = np.asarray(
+        out = readback(
             sorted_grouped_sum(ent["codes"], vals, ent["n_groups"])
         ).astype(np.float64)
         counts = out[0]

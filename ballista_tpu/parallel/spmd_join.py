@@ -149,7 +149,7 @@ class SpmdJoinExec(ExecutionPlan):
         import jax
         import jax.numpy as jnp
 
-        from ballista_tpu.ops.runtime import UnsupportedOnDevice
+        from ballista_tpu.ops.runtime import UnsupportedOnDevice, readback
         from ballista_tpu.physical.joinutil import (
             combined_key_codes,
             take_table,
@@ -245,8 +245,10 @@ class SpmdJoinExec(ExecutionPlan):
         outs = program(
             jnp.asarray(lc), jnp.asarray(lr), jnp.asarray(pc_), jnp.asarray(pr)
         )
-        matched_lrow = np.asarray(outs[0])  # [n_dev * B_p] int32, -1 = no match
-        recv_prow = np.asarray(outs[1])  # [n_dev * B_p] int32, -1 = pad
+        # the matching plane comes back over d2h: account for it, or the
+        # bench readback fields undercount the mesh-join path
+        matched_lrow = readback(outs[0])  # [n_dev * B_p] int32, -1 = no match
+        recv_prow = readback(outs[1])  # [n_dev * B_p] int32, -1 = pad
 
         pairs = (matched_lrow >= 0) & (recv_prow >= 0)
         lidx = matched_lrow[pairs].astype(np.int64)
@@ -254,8 +256,8 @@ class SpmdJoinExec(ExecutionPlan):
         left_out = take_table(left, lidx)
         right_out = take_table(right, ridx)
         if join.join_type == JoinType.LEFT:
-            lmatched = np.asarray(outs[2])  # bool over exchanged left slots
-            recv_lrow = np.asarray(outs[3])
+            lmatched = readback(outs[2])  # bool over exchanged left slots
+            recv_lrow = readback(outs[3])
             un = recv_lrow[(recv_lrow >= 0) & ~lmatched].astype(np.int64)
             if len(un):
                 left_un = take_table(left, un)

@@ -524,9 +524,11 @@ class SpmdAggregateExec(ExecutionPlan):
         codes_g = mh.make_sharded(mesh, codes_blocks, S * n_dev, np.int32)
         valid_g = mh.make_sharded(mesh, valid_blocks, S * n_dev, np.bool_)
 
+        from ballista_tpu.ops.runtime import readback
+
         seg = int(bucket_rows(n_groups, 16)) + 1
         program = self._get_program(mesh, stage, seg, set(cols.keys()), len(aux))
-        stacked = np.asarray(program(cols, aux, codes_g, valid_g))
+        stacked = readback(program(cols, aux, codes_g, valid_g))
         rows = stage._decode_stacked(stacked)
         counts_np = rows[0][:n_groups]
         outputs = [r[:n_groups] for r in rows[1:]]
@@ -628,10 +630,12 @@ class SpmdAggregateExec(ExecutionPlan):
         clen_g = mh.make_sharded(mesh, clen_blocks, V_pad * n_dev, np.int16)
         owner_g = mh.make_sharded(mesh, owner_blocks, V_pad * n_dev, np.int32)
 
+        from ballista_tpu.ops.runtime import readback
+
         program = self._get_sorted_program(
             mesh, stage, G_pad, L1, set(cols.keys()), len(aux)
         )
-        stacked = np.asarray(program(cols, aux, clen_g, owner_g))
+        stacked = readback(program(cols, aux, clen_g, owner_g))
         rows = stage._decode_stacked(stacked)
         counts_np = rows[0][:n_groups]
         outputs = [r[:n_groups] for r in rows[1:]]
@@ -644,7 +648,7 @@ class SpmdAggregateExec(ExecutionPlan):
         so shard d's rows live exactly in block d of the sharded arrays."""
         import jax.numpy as jnp
 
-        from ballista_tpu.ops.runtime import bucket_rows
+        from ballista_tpu.ops.runtime import bucket_rows, readback
 
         live_ns = [d["batch"].num_rows for d in shards if d is not None]
         S = int(bucket_rows(max(live_ns)))
@@ -670,7 +674,7 @@ class SpmdAggregateExec(ExecutionPlan):
 
         seg = int(bucket_rows(n_groups, 16)) + 1  # +1 dump slot
         program = self._get_program(mesh, stage, seg, set(cols.keys()), len(aux))
-        stacked = np.asarray(
+        stacked = readback(
             program(cols, aux, jnp.asarray(codes_big), jnp.asarray(valid_big))
         )
         rows = stage._decode_stacked(stacked)
@@ -684,7 +688,7 @@ class SpmdAggregateExec(ExecutionPlan):
         import jax.numpy as jnp
 
         from ballista_tpu.ops.layout import SortedSegmentLayout
-        from ballista_tpu.ops.runtime import bucket_rows
+        from ballista_tpu.ops.runtime import bucket_rows, readback
 
         layouts: List[Optional[SortedSegmentLayout]] = []
         for d in shards:
@@ -727,7 +731,7 @@ class SpmdAggregateExec(ExecutionPlan):
         program = self._get_sorted_program(
             mesh, stage, G_pad, L1, set(cols.keys()), len(aux)
         )
-        stacked = np.asarray(
+        stacked = readback(
             program(cols, aux, jnp.asarray(clen_big), jnp.asarray(owner_big))
         )
         rows = stage._decode_stacked(stacked)

@@ -46,9 +46,10 @@ class KvBackend:
 
 class MemoryBackend(KvBackend):
     def __init__(self) -> None:
-        self._data: Dict[str, Tuple[bytes, Optional[float]]] = {}
+        self._data: Dict[str, Tuple[bytes, Optional[float]]] = {}  # guarded-by: self._mu
         self._mu = threading.RLock()
 
+    # holds-lock: self._mu
     def _live(self, key: str) -> Optional[bytes]:
         item = self._data.get(key)
         if item is None:
@@ -97,7 +98,9 @@ class SqliteBackend(KvBackend):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._path = path
         self._mu = threading.RLock()
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        # one shared connection, serialized by self._mu (sqlite3 objects are
+        # not thread-safe under check_same_thread=False without it)
+        self._conn = sqlite3.connect(path, check_same_thread=False)  # guarded-by: self._mu
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS kv ("
             "key TEXT PRIMARY KEY, value BLOB NOT NULL, expires REAL)"

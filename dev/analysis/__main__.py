@@ -1,0 +1,81 @@
+"""CLI: python -m dev.analysis [paths...] [--json] [--no-cache] [--list-rules]
+
+Exit codes: 0 clean, 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dev.analysis.core import RULE_NAMES, run_paths
+
+SUPPRESSION_BUDGET = 5  # package-wide cap (ISSUE 3 acceptance criteria)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m dev.analysis",
+        description="ballista-lint: AST-based invariant checker "
+                    "(readback, tracer, dtype, lock, decline discipline)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: ballista_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the per-file result cache")
+    ap.add_argument("--cache-file", default=None,
+                    help="cache location (default: <repo>/.ballista_lint_cache.json)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--max-suppressions", type=int, default=SUPPRESSION_BUDGET,
+                    help="fail when the tree carries more reasoned "
+                         f"suppressions than this (default {SUPPRESSION_BUDGET}; "
+                         "-1 disables)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULE_NAMES():
+            print(r)
+        return 0
+    paths = args.paths or ["ballista_tpu"]
+    try:
+        findings, stats = run_paths(
+            paths, use_cache=not args.no_cache, cache_path=args.cache_file
+        )
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    over_budget = (
+        args.max_suppressions >= 0
+        and stats["suppressions"] > args.max_suppressions
+    )
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "stats": stats,
+            "suppression_budget": args.max_suppressions,
+            "over_suppression_budget": over_budget,
+            "ok": not findings and not over_budget,
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        print(
+            f"ballista-lint: {stats['files']} files "
+            f"({stats['cache_hits']} cached), {len(findings)} finding(s), "
+            f"{stats['suppressions']} suppression(s)"
+        )
+        if over_budget:
+            print(
+                f"ballista-lint: suppression budget exceeded "
+                f"({stats['suppressions']} > {args.max_suppressions})",
+                file=sys.stderr,
+            )
+    return 1 if findings or over_budget else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Shared AST machinery: dotted-name resolution, function-local taint
+propagation, device-path scoping, and discovery of traced functions
+(everything reachable from a jit/shard_map/pallas decoration site)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+DEVICE_PATH_RE = re.compile(r"ballista_tpu/(ops|parallel)/[^/]+\.py$")
+
+
+def is_device_path(display_path: str) -> bool:
+    return bool(DEVICE_PATH_RE.search(display_path.replace("\\", "/")))
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'np.asarray' for Attribute/Name chains; None for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def final_name(node: ast.AST) -> Optional[str]:
+    """Last segment of a Name/Attribute (call targets of any base)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def walk_no_nested_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """Walk a function body without descending into nested function/class
+    definitions (they are analyzed as their own scopes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def iter_functions(tree: ast.Module):
+    """Yield (func, enclosing_class_or_None) for every def at any depth."""
+    def rec(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+                yield from rec(child, cls)
+            elif isinstance(child, ast.ClassDef):
+                yield from rec(child, child)
+            else:
+                yield from rec(child, cls)
+
+    yield from rec(tree, None)
+
+
+class Taint:
+    """Function-local forward taint: seeds are expressions `is_source`
+    accepts; assignment targets of tainted right-hand sides become tainted,
+    as do calls through tainted callees, subscripts, and attributes.
+    Iterates to a fixpoint so textual order doesn't matter."""
+
+    def __init__(self, func: ast.AST,
+                 is_source: Callable[[ast.Call, "Taint"], bool]):
+        self.func = func
+        self.is_source = is_source
+        self.names: Set[str] = set()
+        self._solve()
+
+    def expr_tainted(self, expr: ast.AST) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and node.id in self.names:
+                return True
+            if isinstance(node, ast.Call) and self.call_tainted(node):
+                return True
+        return False
+
+    def call_tainted(self, call: ast.Call) -> bool:
+        if self.is_source(call, self):
+            return True
+        # call through a tainted value: run(...), program(...)(...)
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.names:
+            return True
+        if isinstance(f, ast.Call) and self.call_tainted(f):
+            return True
+        return False
+
+    def _targets(self, t: ast.AST) -> List[str]:
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out = []
+            for e in t.elts:
+                out.extend(self._targets(e))
+            return out
+        if isinstance(t, ast.Starred):
+            return self._targets(t.value)
+        return []
+
+    def _solve(self) -> None:
+        assigns = [
+            n for n in walk_no_nested_defs(self.func)
+            if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+        ]
+        for _ in range(6):
+            changed = False
+            for a in assigns:
+                value = a.value
+                if value is None:
+                    continue
+                if not self.expr_tainted(value):
+                    continue
+                targets = (
+                    a.targets if isinstance(a, ast.Assign) else [a.target]
+                )
+                for t in targets:
+                    for name in self._targets(t):
+                        if name not in self.names:
+                            self.names.add(name)
+                            changed = True
+            if not changed:
+                return
+
+
+# -- traced-function discovery ----------------------------------------------
+# Decoration sites: @jax.jit, jax.jit(fn), jax.jit(factory(...)),
+# functools.partial(jax.jit, ...)(fn_or_factory_call), shard_map(fn, ...),
+# pl.pallas_call(kernel, ...). From each resolved function the walk marks
+# nested defs and same-module callees (by bare name / self-method name)
+# traced, transitively. Project convention: module-level helpers named
+# `jnp_*` or `widen_cols` are in-program by contract and always traced.
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_WRAP_FINAL = {"shard_map", "pallas_call"}
+_CONVENTION_RE = re.compile(r"^jnp_|^widen_cols$")
+
+
+def _is_partial_jit(call: ast.Call) -> bool:
+    """functools.partial(jax.jit, ...) — its result wraps like jax.jit."""
+    if final_name(call.func) != "partial" or not call.args:
+        return False
+    return dotted(call.args[0]) in _JIT_NAMES
+
+
+class ModuleIndex:
+    """Name -> FunctionDef lookups for one module (bare-name resolution:
+    good enough for this codebase, where helper names are unique)."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        self.parent_func: Dict[ast.AST, Optional[ast.AST]] = {}
+        for func, _cls in iter_functions(tree):
+            self.by_name.setdefault(func.name, []).append(func)
+
+        def rec(node, cur):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.parent_func[child] = cur
+                    rec(child, child)
+                else:
+                    rec(child, cur)
+
+        rec(tree, None)
+
+    def resolve(self, name: Optional[str]) -> List[ast.AST]:
+        return self.by_name.get(name, []) if name else []
+
+
+def _wrapped_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The function expression a decoration-site call wraps, if any."""
+    name = dotted(call.func)
+    fin = final_name(call.func)
+    if name in _JIT_NAMES or fin in _WRAP_FINAL:
+        return call.args[0] if call.args else None
+    if isinstance(call.func, ast.Call) and _is_partial_jit(call.func):
+        return call.args[0] if call.args else None
+    return None
+
+
+def _returned_inner_defs(factory: ast.AST, index: ModuleIndex) -> List[ast.AST]:
+    """Inner defs a factory function returns (jax.jit(self._core()) style:
+    the traced function is the closure `_core` builds and returns)."""
+    inner = {
+        n.name: n
+        for n in ast.walk(factory)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not factory
+    }
+    out = []
+    for node in ast.walk(factory):
+        if isinstance(node, ast.Return) and node.value is not None:
+            for leaf in ast.walk(node.value):
+                if isinstance(leaf, ast.Name) and leaf.id in inner:
+                    out.append(inner[leaf.id])
+    return out
+
+
+def traced_functions(tree: ast.Module) -> Set[ast.AST]:
+    index = ModuleIndex(tree)
+    traced: Set[ast.AST] = set()
+
+    def seed(expr: Optional[ast.AST]) -> None:
+        if expr is None:
+            return
+        if isinstance(expr, ast.Lambda):
+            return  # lambdas have no statements to check
+        name = final_name(expr)
+        if name:
+            for fn in index.resolve(name):
+                traced.add(fn)
+            return
+        if isinstance(expr, ast.Call):
+            # jax.jit(self._sorted_core()) — the factory's returned closure
+            for factory in index.resolve(final_name(expr.func)):
+                for fn in _returned_inner_defs(factory, index):
+                    traced.add(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            seed(_wrapped_arg(node))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if dotted(deco) in _JIT_NAMES:
+                    traced.add(node)
+                elif isinstance(deco, ast.Call) and (
+                    dotted(deco.func) in _JIT_NAMES or _is_partial_jit(deco)
+                    or final_name(deco.func) == "when"  # pl.when
+                ):
+                    traced.add(node)
+            if _CONVENTION_RE.match(node.name):
+                traced.add(node)
+
+    # transitive closure: nested defs + same-module callees
+    work = list(traced)
+    while work:
+        fn = work.pop()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+                if node not in traced:
+                    traced.add(node)
+                    work.append(node)
+            elif isinstance(node, ast.Call):
+                callee = final_name(node.func)
+                for target in index.resolve(callee):
+                    if target not in traced:
+                        traced.add(target)
+                        work.append(target)
+    return traced

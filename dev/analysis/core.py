@@ -1,0 +1,351 @@
+"""Framework: findings, per-file source model (comments, suppressions,
+annotations), rule registry, per-file cache, and the directory runner."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, List, Optional, Tuple
+
+META_RULE = "lint-usage"
+
+# populated by dev.analysis.rules at import time (rule name -> check fn)
+_REGISTRY: Dict[str, object] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def RULE_NAMES() -> List[str]:
+    _load_rules()
+    return sorted(_REGISTRY) + [META_RULE]
+
+
+def _load_rules() -> None:
+    if _REGISTRY:
+        return
+    from dev.analysis import (  # noqa: F401
+        rules_decline,
+        rules_dtype,
+        rules_guarded,
+        rules_readback,
+        rules_tracer,
+    )
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_DIRECTIVE_RE = re.compile(r"#\s*ballista-lint:\s*(.*)")
+_DISABLE_RE = re.compile(r"disable=([\w.,-]+)(?:\s*--\s*(.*\S))?\s*$")
+_PATH_RE = re.compile(r"path=(\S+)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\S[^#]*?)\s*$")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(\S[^#]*?)\s*$")
+
+
+@dataclasses.dataclass
+class Suppression:
+    lines: Tuple[int, ...]  # physical lines this suppression covers
+    rules: Tuple[str, ...]
+    reason: Optional[str]
+    comment_line: int
+    used: bool = False
+
+
+class SourceFile:
+    """Parsed view of one file: AST + comment-driven directives.
+
+    `path` is the display/scoping path: relative to the repo root when the
+    file lives under it, and overridable by a `# ballista-lint: path=...`
+    header so test fixtures can exercise device-path-scoped rules."""
+
+    def __init__(self, real_path: str, source: str, display_path: str):
+        self.real_path = real_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=real_path)
+        self.suppressions: List[Suppression] = []
+        self.guarded: Dict[int, str] = {}  # line -> lock expr
+        self.holds: Dict[int, str] = {}  # line -> lock expr
+        self.meta_findings: List[Finding] = []
+        self.path = display_path
+        self._scan_comments()
+
+    # -- comment scanning --------------------------------------------------
+    def _scan_comments(self) -> None:
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.source).readline))
+        except tokenize.TokenError:
+            return
+        known = set(_REGISTRY) | {META_RULE}
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            line = tok.start[0]
+            standalone = self.lines[line - 1][: tok.start[1]].strip() == ""
+            text = tok.string
+            g = _GUARDED_RE.search(text)
+            if g:
+                # a standalone annotation covers the next line's statement
+                self.guarded[line if not standalone else line + 1] = g.group(1).strip()
+            h = _HOLDS_RE.search(text)
+            if h:
+                self.holds[line] = h.group(1).strip()
+            m = _DIRECTIVE_RE.search(text)
+            if not m:
+                continue
+            body = m.group(1).strip()
+            if line <= 10 and _PATH_RE.match(body):
+                self.path = _PATH_RE.match(body).group(1)
+                continue
+            d = _DISABLE_RE.match(body)
+            if not d:
+                self.meta_findings.append(
+                    Finding(META_RULE, self.path, line, tok.start[1],
+                            f"unrecognized ballista-lint directive: {body!r}")
+                )
+                continue
+            rules = tuple(r.strip() for r in d.group(1).split(",") if r.strip())
+            reason = d.group(2)
+            unknown = [r for r in rules if r not in known]
+            if unknown:
+                self.meta_findings.append(
+                    Finding(META_RULE, self.path, line, tok.start[1],
+                            f"suppression names unknown rule(s) {unknown}; "
+                            f"known: {sorted(known)}")
+                )
+            if not reason:
+                self.meta_findings.append(
+                    Finding(META_RULE, self.path, line, tok.start[1],
+                            "suppression without a reason — write "
+                            "'# ballista-lint: disable=<rule> -- <why>'")
+                )
+                continue  # a reasonless suppression does not suppress
+            covered = (line,) if not standalone else (line, line + 1)
+            self.suppressions.append(Suppression(covered, rules, reason, line))
+
+    # -- annotation lookup -------------------------------------------------
+    def guarded_targets(self) -> List[Tuple[ast.AST, str]]:
+        """(assignment statement, lock expr) pairs for every statement a
+        guarded-by comment attaches to."""
+        out = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                lock = self.guarded.get(node.lineno)
+                if lock:
+                    out.append((node, lock))
+        return out
+
+    def holds_lock(self, func: ast.AST) -> Optional[str]:
+        """Lock named by a `# holds-lock:` comment on the def's signature."""
+        if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        end = func.body[0].lineno if func.body else func.lineno + 1
+        # lineno-1 covers a standalone annotation directly above the def
+        for line in range(func.lineno - 1, end + 1):
+            if line in self.holds:
+                return self.holds[line]
+        return None
+
+    # -- suppression application -------------------------------------------
+    def apply_suppressions(self, findings: List[Finding]) -> List[Finding]:
+        kept = []
+        for f in findings:
+            hit = None
+            for s in self.suppressions:
+                if f.rule in s.rules and f.line in s.lines:
+                    hit = s
+                    break
+            if hit is None:
+                kept.append(f)
+            else:
+                hit.used = True
+        for s in self.suppressions:
+            if not s.used:
+                kept.append(
+                    Finding(META_RULE, self.path, s.comment_line, 0,
+                            f"unused suppression for {', '.join(s.rules)} — "
+                            "remove it or move it onto the flagged line")
+                )
+        return kept
+
+
+# -- per-file analysis -------------------------------------------------------
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _display_path(path: str) -> str:
+    ap = os.path.abspath(path)
+    root = _repo_root()
+    return os.path.relpath(ap, root) if ap.startswith(root + os.sep) else path
+
+
+def _analyze(path: str) -> Tuple[List[Finding], int]:
+    """(surviving findings, reasoned-suppression count) for one file —
+    one read/parse/tokenize pass serves both."""
+    _load_rules()
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    try:
+        sf = SourceFile(path, source, _display_path(path))
+    except SyntaxError as e:
+        return [Finding(META_RULE, _display_path(path), e.lineno or 1, 0,
+                        f"syntax error: {e.msg}")], 0
+    findings: List[Finding] = []
+    for name, check in sorted(_REGISTRY.items()):
+        findings.extend(check(sf))
+    findings = sf.apply_suppressions(findings)
+    findings.extend(sf.meta_findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, len(sf.suppressions)
+
+
+def analyze_file(path: str) -> List[Finding]:
+    """All surviving findings for one file (suppressions applied)."""
+    return _analyze(path)[0]
+
+
+def suppression_count(path: str) -> int:
+    """Reasoned suppressions present in a file (for budget accounting)."""
+    return _analyze(path)[1]
+
+
+# -- cache -------------------------------------------------------------------
+
+CACHE_BASENAME = ".ballista_lint_cache.json"
+
+
+def _analyzer_hash() -> str:
+    """Hash of the analyzer's own sources: a rule change invalidates every
+    cached verdict."""
+    d = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha1()
+    for name in sorted(os.listdir(d)):
+        if name.endswith(".py"):
+            with open(os.path.join(d, name), "rb") as f:
+                h.update(name.encode())
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+class FileCache:
+    def __init__(self, cache_path: Optional[str]):
+        self.cache_path = cache_path
+        self.data: Dict[str, dict] = {}
+        self.dirty = False
+        self.hits = 0
+        self._ahash = _analyzer_hash()
+        if cache_path and os.path.exists(cache_path):
+            try:
+                with open(cache_path) as f:
+                    blob = json.load(f)
+                if blob.get("analyzer") == self._ahash:
+                    self.data = blob.get("files", {})
+            except (OSError, ValueError):
+                pass
+
+    def _key(self, path: str) -> str:
+        st = os.stat(path)
+        return f"{st.st_mtime_ns}:{st.st_size}"
+
+    def get(self, path: str) -> Optional[Tuple[List[Finding], int]]:
+        ap = os.path.abspath(path)
+        ent = self.data.get(ap)
+        if ent is None or ent.get("key") != self._key(path):
+            return None
+        self.hits += 1
+        return [Finding(**f) for f in ent["findings"]], ent.get("suppressions", 0)
+
+    def put(self, path: str, findings: List[Finding], suppressions: int) -> None:
+        ap = os.path.abspath(path)
+        self.data[ap] = {
+            "key": self._key(path),
+            "findings": [f.to_dict() for f in findings],
+            "suppressions": suppressions,
+        }
+        self.dirty = True
+
+    def save(self) -> None:
+        if not self.cache_path or not self.dirty:
+            return
+        tmp = self.cache_path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"analyzer": self._ahash, "files": self.data}, f)
+            os.replace(tmp, self.cache_path)
+        except OSError:
+            pass
+
+
+# -- runner ------------------------------------------------------------------
+
+def collect_py_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".jax_cache")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        out.append(os.path.join(root, name))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def run_paths(paths: List[str], use_cache: bool = True,
+              cache_path: Optional[str] = None) -> Tuple[List[Finding], dict]:
+    """Analyze every .py under `paths`. Returns (findings, stats)."""
+    _load_rules()
+    files = collect_py_files(paths)
+    if use_cache and cache_path is None:
+        cache_path = os.path.join(_repo_root(), CACHE_BASENAME)
+    cache = FileCache(cache_path if use_cache else None)
+    findings: List[Finding] = []
+    n_suppressions = 0
+    for path in files:
+        cached = cache.get(path) if use_cache else None
+        if cached is not None:
+            result, n_supp = cached
+        else:
+            result, n_supp = _analyze(path)
+            if use_cache:
+                cache.put(path, result, n_supp)
+        findings.extend(result)
+        n_suppressions += n_supp
+    cache.save()
+    stats = {
+        "files": len(files),
+        "cache_hits": cache.hits,
+        "suppressions": n_suppressions,
+        "findings": len(findings),
+    }
+    return findings, stats

@@ -1,0 +1,88 @@
+"""decline-discipline: device paths bail to host ONLY through the
+canonical decline signals, so the kernels ladder stays enumerable:
+
+- `raise UnsupportedOnDevice("<reason>")` — the reason string is mandatory
+  (a bare decline is invisible in logs and unanalyzable in bench output);
+- the ops/kernels.py helpers: `decline(reason)` (raising form),
+  `host_fallback(reason)` (Optional-sentinel form, logs + counts), and
+  `step_aside(reason)` (mid-ladder: the next rung still gets tried).
+
+Checks, scoped to ballista_tpu/ops/ and ballista_tpu/parallel/:
+
+1. `raise UnsupportedOnDevice()` / `raise TooManyGroups()` with no reason
+   (or an empty one) is flagged;
+2. inside an `except UnsupportedOnDevice` (or TooManyGroups) handler, a
+   bare `return None` silently converts a reasoned decline into an
+   anonymous host fallback — return `host_fallback(<reason>)` instead;
+3. ad-hoc `raise Exception/RuntimeError/NotImplementedError` is not a
+   decline channel (callers catch UnsupportedOnDevice; anything else
+   either crashes the query or is swallowed by a broad fallback handler
+   that then logs it as a real error)."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from dev.analysis.common import final_name, is_device_path
+from dev.analysis.core import Finding, SourceFile, register
+
+_DECLINE_TYPES = {"UnsupportedOnDevice", "TooManyGroups"}
+_ADHOC_TYPES = {"Exception", "RuntimeError", "NotImplementedError"}
+
+
+def _handler_catches_decline(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(final_name(x) in _DECLINE_TYPES for x in types)
+
+
+@register("decline-discipline")
+def check(sf: SourceFile) -> List[Finding]:
+    if not is_device_path(sf.path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Raise) and isinstance(node.exc, ast.Call):
+            name = final_name(node.exc.func)
+            if name in _DECLINE_TYPES:
+                args = node.exc.args
+                empty = not args or (
+                    isinstance(args[0], ast.Constant)
+                    and not str(args[0].value).strip()
+                )
+                if empty:
+                    findings.append(Finding(
+                        "decline-discipline", sf.path, node.lineno,
+                        node.col_offset,
+                        f"{name} raised without a reason — every decline "
+                        "must say why (the ladder must stay enumerable)",
+                    ))
+            elif name in _ADHOC_TYPES:
+                findings.append(Finding(
+                    "decline-discipline", sf.path, node.lineno,
+                    node.col_offset,
+                    f"ad-hoc `raise {name}` in a device-path module — "
+                    "decline with UnsupportedOnDevice(reason) / "
+                    "kernels.decline(reason), or raise a specific typed "
+                    "error",
+                ))
+        elif isinstance(node, ast.ExceptHandler) and _handler_catches_decline(node):
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Return):
+                    v = inner.value
+                    is_none = v is None or (
+                        isinstance(v, ast.Constant) and v.value is None
+                    )
+                    if is_none:
+                        findings.append(Finding(
+                            "decline-discipline", sf.path, inner.lineno,
+                            inner.col_offset,
+                            "silent `return None` inside an "
+                            "UnsupportedOnDevice handler — return "
+                            "kernels.host_fallback(reason) so the decline "
+                            "is logged and counted",
+                        ))
+    return findings

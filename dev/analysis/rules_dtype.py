@@ -1,0 +1,107 @@
+"""dtype-discipline: the f64->f32 narrowing policy (ops/runtime.py module
+docstring) — float64 must never reach traced code or flow into a device
+transfer. Two checks:
+
+1. any float64 mention inside a traced function (device compute is f32/
+   int32 by contract; f64 is emulated and slow on TPU, and int packing
+   assumes f32 lanes);
+2. in device-path modules, a value created as float64 (astype/np.array
+   dtype=...) must not flow into jnp.asarray / jax.device_put /
+   make_sharded.
+
+Host-side post-readback widening to float64 (Arrow result columns, the
+int-exact host folds in ops/layout.py) is the documented result dtype and
+is deliberately NOT flagged. ops/floatbits.py is whitelisted whole: its
+f64<->i64 bijection is the documented exception to the narrowing policy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from dev.analysis.common import (
+    Taint,
+    dotted,
+    final_name,
+    is_device_path,
+    iter_functions,
+    traced_functions,
+    walk_no_nested_defs,
+)
+from dev.analysis.core import Finding, SourceFile, register
+
+_TRANSFERS = {"asarray", "device_put", "make_sharded"}
+_TRANSFER_MODULES = ("jnp", "jax", "mh", "multihost")
+_CREATORS = {"array", "zeros", "ones", "full", "empty", "asarray", "arange"}
+
+
+def _mentions_f64(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Attribute, ast.Name)) and final_name(n) == "float64":
+            return True
+        if isinstance(n, ast.Constant) and n.value == "float64":
+            return True
+    return False
+
+
+def _creates_f64(call: ast.Call) -> bool:
+    """astype(np.float64), np.zeros(..., dtype=np.float64), np.float64(x)."""
+    name = final_name(call.func)
+    if name == "float64":
+        return True
+    if name == "astype":
+        return any(_mentions_f64(a) for a in call.args)
+    if name in _CREATORS:
+        if any(_mentions_f64(a) for a in call.args[1:]):
+            return True
+        return any(k.arg == "dtype" and _mentions_f64(k.value) for k in call.keywords)
+    return False
+
+
+@register("dtype-discipline")
+def check(sf: SourceFile) -> List[Finding]:
+    path = sf.path.replace("\\", "/")
+    if path.endswith("ballista_tpu/ops/floatbits.py"):
+        return []
+    findings: List[Finding] = []
+
+    # 1. float64 inside traced code
+    for func in traced_functions(sf.tree):
+        for node in walk_no_nested_defs(func):
+            if isinstance(node, (ast.Attribute, ast.Name, ast.Constant)) and (
+                (isinstance(node, ast.Constant) and node.value == "float64")
+                or final_name(node) == "float64"
+            ):
+                findings.append(Finding(
+                    "dtype-discipline", sf.path, node.lineno, node.col_offset,
+                    f"float64 inside traced function '{func.name}' — device "
+                    "compute is f32/int32 by the narrowing policy "
+                    "(ops/runtime.py); f64 is emulated on TPU",
+                ))
+
+    # 2. f64-created values flowing into a device transfer
+    if not is_device_path(sf.path):
+        return findings
+    for func, _cls in iter_functions(sf.tree):
+        taint = Taint(func, lambda call, t: _creates_f64(call))
+        for node in walk_no_nested_defs(func):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fname = final_name(node.func)
+            if fname not in _TRANSFERS:
+                continue
+            base = dotted(node.func)
+            if base and "." in base and base.split(".")[0] not in _TRANSFER_MODULES:
+                continue
+            arg = node.args[1] if fname == "make_sharded" and len(node.args) > 1 else node.args[0]
+            if taint.expr_tainted(arg) or (
+                isinstance(arg, ast.Call) and _creates_f64(arg)
+            ):
+                findings.append(Finding(
+                    "dtype-discipline", sf.path, node.lineno, node.col_offset,
+                    "float64-created value flows into a device transfer in "
+                    f"'{func.name}' — narrow to f32/int32 first "
+                    "(ops/runtime.py narrowing policy)",
+                ))
+    return findings

@@ -1,0 +1,77 @@
+"""tracer-hygiene: functions traced by jit/shard_map/pallas (including
+same-module callees reached from the decoration sites) must not branch on,
+or host-materialize, values derived from jnp/jax.lax calls — those are
+abstract tracers at trace time, and `if`/`while`/`bool()`/`float()`/
+`int()`/`.item()` on them either crashes (ConcretizationTypeError) or, via
+a silent python fallback, bakes one batch's data into the compiled program.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from dev.analysis.common import (
+    Taint,
+    dotted,
+    final_name,
+    traced_functions,
+    walk_no_nested_defs,
+)
+from dev.analysis.core import Finding, SourceFile, register
+
+_TRACER_PREFIXES = ("jnp.", "jax.lax.", "jax.ops.", "jax.nn.", "jax.numpy.")
+_CASTS = {"bool", "int", "float"}
+
+
+def _is_tracer_call(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    if name is None:
+        return False
+    return any(name.startswith(p) or name == p[:-1] for p in _TRACER_PREFIXES)
+
+
+@register("tracer-hygiene")
+def check(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    traced = traced_functions(sf.tree)
+    if not traced:
+        return findings
+    for func in traced:
+        params = {
+            a.arg
+            for a in list(func.args.args) + list(func.args.kwonlyargs)
+            + list(func.args.posonlyargs)
+            if a.arg not in ("self", "cls")
+        }
+        taint = Taint(func, lambda call, t: _is_tracer_call(call))
+        for node in walk_no_nested_defs(func):
+            if isinstance(node, (ast.If, ast.While)):
+                if taint.expr_tainted(node.test):
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    findings.append(Finding(
+                        "tracer-hygiene", sf.path, node.lineno, node.col_offset,
+                        f"`{kw}` branches on a jnp-derived value inside traced "
+                        f"function '{func.name}' — use jnp.where/lax.cond; a "
+                        "tracer has no concrete truth value",
+                    ))
+            elif isinstance(node, ast.Call):
+                fname = dotted(node.func)
+                if fname in _CASTS and node.args and taint.expr_tainted(node.args[0]):
+                    findings.append(Finding(
+                        "tracer-hygiene", sf.path, node.lineno, node.col_offset,
+                        f"{fname}() on a jnp-derived value inside traced "
+                        f"function '{func.name}' forces host materialization "
+                        "at trace time",
+                    ))
+                elif (final_name(node.func) == "item"
+                      and isinstance(node.func, ast.Attribute)):
+                    base = node.func.value
+                    base_is_param = isinstance(base, ast.Name) and base.id in params
+                    if base_is_param or taint.expr_tainted(base):
+                        findings.append(Finding(
+                            "tracer-hygiene", sf.path, node.lineno, node.col_offset,
+                            f".item() inside traced function '{func.name}' "
+                            "materializes a tracer at trace time",
+                        ))
+    return findings

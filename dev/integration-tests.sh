@@ -9,6 +9,12 @@ export PYTHONPATH="$PWD:${PYTHONPATH:-}"
 DATA=${DATA:-/tmp/ballista-tpu-it}
 SF=${SF:-0.01}
 
+# strict static-analysis gate FIRST: the device-path invariants (readback
+# accounting, tracer hygiene, dtype narrowing, lock discipline, decline
+# ladder) are machine-checked before anything executes — a violation fails
+# the tier in seconds instead of surfacing as a wrong bench number later
+python -m dev.analysis ballista_tpu/
+
 [ -d "$DATA/lineitem" ] || python -m benchmarks.tpch.runner datagen --sf "$SF" --out "$DATA" --parts 2
 
 python - <<'PY'

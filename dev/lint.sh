@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# ballista-lint entry point: AST-based invariant checks (readback, tracer,
+# dtype, lock, decline discipline) over the production tree. Strict: any
+# finding — or more than the 5-suppression budget — fails. Pass extra
+# paths/flags through, e.g. `dev/lint.sh --json` or `dev/lint.sh tests/`.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD:${PYTHONPATH:-}"
+
+if [ "$#" -gt 0 ] && [ "${1#-}" = "$1" ]; then
+    # explicit paths given: lint those
+    exec python -m dev.analysis "$@"
+fi
+exec python -m dev.analysis ballista_tpu/ "$@"

@@ -1,0 +1,21 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_decline_bad.py
+"""BAD: reasonless decline, silent None decline, ad-hoc bail."""
+
+
+class UnsupportedOnDevice(Exception):
+    pass
+
+
+def lower(col):
+    if col is None:
+        raise UnsupportedOnDevice()  # no reason
+    if not hasattr(col, "dtype"):
+        raise RuntimeError("can't lower")  # ad-hoc bail
+    return col
+
+
+def entry(col):
+    try:
+        return lower(col)
+    except UnsupportedOnDevice:
+        return None  # silent decline

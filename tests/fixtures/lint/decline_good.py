@@ -1,0 +1,18 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_decline_good.py
+"""GOOD: reasoned declines through the canonical signals."""
+
+from ballista_tpu.ops.kernels import host_fallback
+from ballista_tpu.ops.runtime import UnsupportedOnDevice
+
+
+def lower(col):
+    if col is None:
+        raise UnsupportedOnDevice("null column has no device representation")
+    return col
+
+
+def entry(col):
+    try:
+        return lower(col)
+    except UnsupportedOnDevice as e:
+        return host_fallback(f"fixture lowering: {e}")

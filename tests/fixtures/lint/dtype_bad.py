@@ -1,0 +1,20 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_dtype_bad.py
+"""BAD: float64 reaching the device — in-trace widening and an f64 host
+array flowing into a device transfer."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def widen_in_trace(x):
+    return x.astype(np.float64)  # f64 compute inside the program
+
+
+def upload_wide(col):
+    wide = col.astype(np.float64)
+    return jnp.asarray(wide)  # f64 crosses h2d
+
+
+def upload_created(n):
+    return jnp.asarray(np.zeros(n, dtype=np.float64))

@@ -1,0 +1,17 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_dtype_good.py
+"""GOOD: narrow before upload; post-readback host widening to f64 is the
+documented result dtype and is not a violation."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ballista_tpu.ops.runtime import readback
+
+
+def upload_narrow(col):
+    return jnp.asarray(col.astype(np.float32))
+
+
+def host_fold_after_readback(program, cols):
+    stacked = readback(program(cols))
+    return stacked.astype(np.float64)  # host-side result widening: fine

@@ -1,0 +1,32 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_guarded_good.py
+"""GOOD: every touch under the lock (or inside a holds-lock helper whose
+callers hold it); __init__ registration is exempt."""
+import threading
+
+_lock = threading.Lock()
+_totals = {"rows": 0}  # guarded-by: _lock
+
+
+def bump(n):
+    with _lock:
+        _totals["rows"] += n
+
+
+# holds-lock: _lock
+def _bump_locked(n):
+    _totals["rows"] += n
+
+
+def bump_via_helper(n):
+    with _lock:
+        _bump_locked(n)
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._entries = []  # guarded-by: self._mu
+
+    def add(self, x):
+        with self._mu:
+            self._entries.append(x)

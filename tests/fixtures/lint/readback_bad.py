@@ -1,0 +1,17 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_readback_bad.py
+"""BAD: compiled-program result materialized with no readback accounting."""
+import jax
+import numpy as np
+
+
+def run_stage(cols):
+    program = jax.jit(lambda c: c)
+    out = program(cols)
+    return np.asarray(out)  # unrecorded d2h transfer
+
+
+def run_via_handle(cols, aux):
+    from somewhere import _compile_predicate  # noqa
+
+    compiler, run = _compile_predicate(cols, aux)
+    return np.asarray(run(cols, aux))  # unrecorded d2h transfer

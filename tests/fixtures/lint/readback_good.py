@@ -1,0 +1,24 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_readback_good.py
+"""GOOD: both pairing styles — explicit record_readback, and the
+runtime.readback helper."""
+import jax
+import numpy as np
+
+from ballista_tpu.ops.runtime import readback, record_readback
+
+
+def run_stage(cols):
+    program = jax.jit(lambda c: c)
+    arr = np.asarray(program(cols))
+    record_readback(arr.shape[-1], arr.nbytes)
+    return arr
+
+
+def run_stage_helper(cols):
+    program = jax.jit(lambda c: c)
+    return readback(program(cols))
+
+
+def host_only(batch):
+    # np.asarray of host data is not a readback
+    return np.asarray(batch.column(0))

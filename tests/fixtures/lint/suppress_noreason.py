@@ -1,0 +1,10 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_suppress_noreason.py
+"""A suppression without a reason does not suppress AND is itself flagged."""
+import jax
+import numpy as np
+
+
+def run_stage(cols):
+    program = jax.jit(lambda c: c)
+    # ballista-lint: disable=readback-discipline
+    return np.asarray(program(cols))

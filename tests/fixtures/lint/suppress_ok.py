@@ -1,0 +1,10 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_suppress_ok.py
+"""A reasoned suppression silences exactly its rule on its line."""
+import jax
+import numpy as np
+
+
+def run_stage(cols):
+    program = jax.jit(lambda c: c)
+    # ballista-lint: disable=readback-discipline -- fixture: transport layer whose caller records
+    return np.asarray(program(cols))

@@ -1,0 +1,26 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_tracer_bad.py
+"""BAD: branching on / materializing tracer values inside traced code,
+including a helper reached from the decoration site via the call graph."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branchy(x):
+    s = jnp.sum(x)
+    if s > 0:  # tracer has no concrete truth value
+        return x
+    return float(jnp.max(x))  # host materialization at trace time
+
+
+def helper(v):
+    while jnp.any(v > 0):  # reached from traced `wrapped` below
+        v = v - 1
+    return v
+
+
+def wrapped(x):
+    return helper(x)
+
+
+traced = jax.jit(wrapped)

@@ -1,0 +1,28 @@
+# ballista-lint: path=ballista_tpu/ops/fixture_tracer_good.py
+"""GOOD: data-dependent selection via jnp.where; python-level branching
+only on static (non-tracer) structure."""
+import jax
+import jax.numpy as jnp
+
+N_LANES = 4
+
+
+@jax.jit
+def select(x):
+    s = jnp.sum(x)
+    return jnp.where(s > 0, x, -x)
+
+
+def build_core(use_abs):
+    def core(x):
+        if use_abs:  # closure over a static python bool: fine
+            x = jnp.abs(x)
+        out = []
+        for lane in range(N_LANES):  # static unroll: fine
+            out.append(x + lane)
+        return jnp.stack(out)
+
+    return core
+
+
+traced = jax.jit(build_core(True))

@@ -40,14 +40,27 @@ def _extrema_floats(rng, n):
     return v
 
 
+def _null_heavy_strings(rng, n):
+    """~45% null string column (its own rng stream, like fx): nulls ride the
+    device as -1 dictionary codes and every code predicate must apply SQL
+    three-valued logic to them (ops/runtime.py::column_to_numpy)."""
+    vals = rng.integers(0, 7, n)
+    nulls = rng.random(n) < 0.45
+    return pa.array(
+        [None if isnull else f"x{v}" for v, isnull in zip(vals, nulls)],
+        type=pa.string(),
+    )
+
+
 def _random_table(rng, n):
     cols = {
         "i8": pa.array(rng.integers(-100, 100, n), type=pa.int64()),
         "ibig": pa.array(rng.integers(-10**8, 10**8, n), type=pa.int64()),
         "f": pa.array(np.round(rng.uniform(-1000, 1000, n), 2)),
-        # fx draws from its own rng so the baseline columns (and every
-        # query the original stream generates) stay byte-identical
+        # fx and sn draw from their own rngs so the baseline columns (and
+        # every query the original stream generates) stay byte-identical
         "fx": pa.array(_extrema_floats(np.random.default_rng(n ^ 0xF10A7), n)),
+        "sn": _null_heavy_strings(np.random.default_rng(n ^ 0x5EED), n),
         "g": pa.array(rng.integers(0, rng.integers(2, 3000), n),
                       type=pa.int64()),
         "s": pa.array([f"tag{v}" for v in rng.integers(0, 9, n)]),
@@ -83,12 +96,23 @@ _PREDS = [
     "d >= date '1995-01-01'", "i8 between -50 and 50",
     "s like 'tag%'", "i8 > 0 and f < 0", "i8 < -90 or f > 900",
 ]
+# null-heavy string predicates (ROADMAP fuzzer slice): selected by their
+# OWN rng stream so the baseline queries stay byte-identical. Every shape
+# exercises SQL three-valued logic over the -1 null code on device: the
+# WHERE collapse must drop NULL rows for =/<>/LIKE/IN, and IS [NOT] NULL
+# is the explicit code test.
+_NULLSTR_PREDS = [
+    "sn is null", "sn is not null", "sn = 'x1'", "sn <> 'x2'",
+    "sn like 'x%'", "sn in ('x1', 'x3', 'x5')",
+    "sn is null or sn = 'x2'", "sn is not null and sn <> 'x4'",
+]
 
 
-def _random_query(rng, erng):
+def _random_query(rng, erng, nrng=None):
     """Base query from `rng` (UNCHANGED baseline stream), ORDER BY + LIMIT
-    epilogue decisions from the separate `erng` so the base workload stays
-    identical to the seed suite's."""
+    epilogue decisions from the separate `erng`, null-string predicate
+    injection from `nrng` — so the base workload stays identical to the
+    seed suite's."""
     keys = list(rng.choice(["g", "s", "d"], size=rng.integers(0, 3),
                            replace=False))
     n_aggs = rng.integers(1, 5)
@@ -106,6 +130,10 @@ def _random_query(rng, erng):
     sql = f"select {sel} from t"
     if rng.random() < 0.7:
         sql += f" where {rng.choice(_PREDS)}"
+    if nrng is not None and nrng.random() < 0.5:
+        p = str(nrng.choice(_NULLSTR_PREDS))
+        conj = "and" if nrng.random() < 0.7 else "or"
+        sql += f" {conj} ({p})" if " where " in sql else f" where ({p})"
     if not keys:
         return sql
     sql += " group by " + ", ".join(keys)
@@ -161,8 +189,9 @@ def test_fuzz_aggregates(tmp_path, seed):
         ctx.register_parquet("t", path)
         ctxs[backend] = ctx
     erng = np.random.default_rng(5000 + seed)
+    nrng = np.random.default_rng(9000 + seed)
     for _ in range(4):
-        sql = _random_query(rng, erng)
+        sql = _random_query(rng, erng, nrng)
         _compare(ctxs["tpu"].sql(sql).collect(),
                  ctxs["cpu"].sql(sql).collect(), sql)
 

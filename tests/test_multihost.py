@@ -99,7 +99,7 @@ def _oracle(table, key):
     }
 
 
-def test_two_process_mesh_aggregation(tmp_path):
+def test_two_process_mesh_aggregation(tmp_path, multiprocess_mesh):
     d, full = _dataset(tmp_path)
     outs = _run_workers(d, "int_keys")
 
@@ -147,7 +147,7 @@ def test_partition_ownership_contract():
     ]
 
 
-def test_two_process_highcard_sorted_program(tmp_path):
+def test_two_process_highcard_sorted_program(tmp_path, multiprocess_mesh):
     """G > MAX_GROUPS on the pod: each process builds its shards' sorted
     chunked-segment tiles with collectively-unified L1/V, and the sorted
     shard_map program (segment fold + psum) runs over the global mesh."""

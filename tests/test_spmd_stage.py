@@ -300,6 +300,100 @@ def test_mesh_fewer_partitions_than_devices():
     )
 
 
+def test_mesh_readback_recorded():
+    """Multi-chip readback accounting (ISSUE 3): the mesh aggregate's d2h
+    result transfer must flow through record_readback on BOTH programs —
+    unrolled (G <= 1024) and sorted (G > 1024) — so bench.py's per-config
+    readback fields stop undercounting pod runs."""
+    from ballista_tpu.ops.runtime import readback_stats
+
+    # unrolled mesh program
+    readback_stats(reset=True)
+    table = _sales(n=3000, seed=21)
+    spmd, out = _run_spmd(
+        table, ["region"],
+        [F.sum(col("amount")).alias("s"), F.count(col("qty")).alias("c")],
+    )
+    assert spmd.last_path == "mesh"
+    s = readback_stats(reset=True)
+    assert s["readbacks"] >= 1
+    assert s["rows"] > 0 and s["bytes"] > 0
+
+    # sorted mesh program (G > MAX_GROUPS)
+    rng = np.random.default_rng(23)
+    n, G = 60_000, 5_000
+    big = pa.table(
+        {
+            "k": pa.array(rng.integers(0, G, n).astype(np.int64)),
+            "v": pa.array(rng.uniform(0, 10, n)),
+        }
+    )
+    spmd, out = _run_spmd(
+        big, ["k"], [F.sum(col("v")).alias("s"), F.count(col("v")).alias("c")]
+    )
+    assert spmd.last_path == "mesh"
+    assert out.num_rows > 1024  # the sorted path actually ran
+    s = readback_stats(reset=True)
+    assert s["readbacks"] >= 1
+    assert s["rows"] >= out.num_rows  # padded group axis covers every group
+    assert s["bytes"] > 0
+
+
+def test_mesh_join_readback_recorded():
+    """The SPMD mesh join reads its matching plane back over d2h — those
+    transfers must be accounted too (they were the unrecorded sites ISSUE 3
+    calls out in parallel/spmd_join.py)."""
+    import pyarrow.parquet as pq  # noqa: F401  (parity with other suites)
+
+    from ballista_tpu.ops.runtime import readback_stats
+    from ballista_tpu.parallel.spmd_join import SpmdJoinExec
+    from ballista_tpu.physical.plan import TaskContext
+
+    rng = np.random.default_rng(29)
+    n_b, n_p = 500, 4000
+    build = pa.table(
+        {
+            "bk": pa.array(np.arange(n_b).astype(np.int64)),
+            "bv": pa.array(rng.uniform(0, 1, n_b)),
+        }
+    )
+    probe = pa.table(
+        {
+            "pk": pa.array(rng.integers(0, n_b + 50, n_p).astype(np.int64)),
+            "pv": pa.array(rng.uniform(0, 1, n_p)),
+        }
+    )
+    cfg = BallistaConfig(SPMD_SETTINGS)
+    ctx = ExecutionContext(cfg)
+    ctx.register_record_batches("b", build, n_partitions=2)
+    ctx.register_record_batches("p", probe, n_partitions=3)
+    df = ctx.table("b").join(ctx.table("p"), ["bk"], ["pk"], how="inner")
+    phys = ctx.create_physical_plan(df.logical_plan())
+    stages = DistributedPlanner(cfg).plan_query_stages("job", phys)
+
+    def find(n):
+        if isinstance(n, SpmdJoinExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    spmd = next((find(st) for st in stages if find(st) is not None), None)
+    assert spmd is not None, "planner did not emit SpmdJoinExec"
+    readback_stats(reset=True)
+    tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="j")
+    out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
+    assert spmd.last_path == "mesh"
+    s = readback_stats(reset=True)
+    assert s["readbacks"] >= 2  # matched row ids + probe row ids at minimum
+    assert s["rows"] > 0 and s["bytes"] > 0
+    # sanity: the join itself is right
+    ora = build.join(probe, keys="bk", right_keys="pk", join_type="inner")
+    assert out.num_rows == ora.num_rows
+
+
 def test_mesh_failure_falls_back_and_is_surfaced(monkeypatch, caplog):
     """A broken mesh path must not be invisible: the host fallback still
     returns correct rows, the tracing counter increments, and a warning

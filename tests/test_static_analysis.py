@@ -1,0 +1,205 @@
+"""ballista-lint (dev/analysis): the analyzer itself is tier-1 — a clean
+self-run over ballista_tpu/ gates the tree, each rule is exercised against
+known-bad and known-good fixture snippets, and the suppression syntax
+(mandatory reasons) plus per-file cache behavior are pinned."""
+
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures" / "lint"
+
+sys.path.insert(0, str(REPO))
+
+from dev.analysis.core import (  # noqa: E402
+    RULE_NAMES,
+    analyze_file,
+    run_paths,
+)
+
+RULES = [
+    "readback-discipline",
+    "tracer-hygiene",
+    "dtype-discipline",
+    "guarded-by",
+    "decline-discipline",
+]
+
+
+def _rules_hit(path) -> set:
+    return {f.rule for f in analyze_file(str(path))}
+
+
+# -- the gate: the production tree is clean ---------------------------------
+
+def test_self_run_clean_over_package():
+    findings, stats = run_paths([str(REPO / "ballista_tpu")], use_cache=False)
+    assert findings == [], "\n".join(f.format() for f in findings)
+    # ISSUE 3 acceptance: at most 5 reasoned suppressions in the package
+    assert stats["suppressions"] <= 5
+    assert stats["files"] > 50  # actually swept the tree
+
+
+def test_all_rules_registered():
+    names = RULE_NAMES()
+    for r in RULES:
+        assert r in names
+    assert "lint-usage" in names
+
+
+# -- per-rule fixtures -------------------------------------------------------
+
+@pytest.mark.parametrize("rule", RULES)
+def test_bad_fixture_flags_its_rule(rule):
+    stem = rule.split("-")[0]
+    hit = _rules_hit(FIXTURES / f"{stem}_bad.py")
+    assert rule in hit, f"{rule} did not fire on its bad fixture (hit: {hit})"
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_good_fixture_is_clean(rule):
+    stem = rule.split("-")[0]
+    findings = analyze_file(str(FIXTURES / f"{stem}_good.py"))
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_bad_fixtures_fail_via_cli():
+    """Acceptance: `python -m dev.analysis` exits nonzero on each bad
+    fixture (one CLI invocation per file, as CI would run it)."""
+    for bad in sorted(FIXTURES.glob("*_bad.py")):
+        proc = subprocess.run(
+            [sys.executable, "-m", "dev.analysis", str(bad), "--no-cache"],
+            cwd=str(REPO), capture_output=True, text=True,
+        )
+        assert proc.returncode == 1, (bad, proc.stdout, proc.stderr)
+
+
+def test_tracer_rule_walks_call_graph():
+    """The decoration site is `jax.jit(wrapped)`; the violation lives in a
+    helper `wrapped` calls — the walk must reach it."""
+    findings = analyze_file(str(FIXTURES / "tracer_bad.py"))
+    assert any(
+        f.rule == "tracer-hygiene" and "'helper'" in f.message for f in findings
+    ), "\n".join(f.format() for f in findings)
+
+
+def test_decline_rule_flags_all_three_shapes():
+    findings = [
+        f.message for f in analyze_file(str(FIXTURES / "decline_bad.py"))
+        if f.rule == "decline-discipline"
+    ]
+    assert any("without a reason" in m for m in findings)
+    assert any("ad-hoc" in m for m in findings)
+    assert any("return None" in m for m in findings)
+
+
+def test_guarded_rule_checks_holds_lock_callers():
+    findings = [
+        f.message for f in analyze_file(str(FIXTURES / "guarded_bad.py"))
+        if f.rule == "guarded-by"
+    ]
+    assert any("requires holding" in m for m in findings)
+    assert any("accessed outside" in m for m in findings)
+
+
+# -- suppressions ------------------------------------------------------------
+
+def test_suppression_with_reason_suppresses():
+    findings = analyze_file(str(FIXTURES / "suppress_ok.py"))
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_suppression_without_reason_rejected():
+    findings = analyze_file(str(FIXTURES / "suppress_noreason.py"))
+    rules = {f.rule for f in findings}
+    assert "lint-usage" in rules  # the reasonless directive is itself flagged
+    assert "readback-discipline" in rules  # and it did NOT suppress
+
+
+def test_unused_suppression_flagged(tmp_path):
+    p = tmp_path / "unused.py"
+    p.write_text(
+        "# ballista-lint: path=ballista_tpu/ops/fixture_unused.py\n"
+        "x = 1  # ballista-lint: disable=readback-discipline -- nothing here\n"
+    )
+    findings = analyze_file(str(p))
+    assert any(
+        f.rule == "lint-usage" and "unused suppression" in f.message
+        for f in findings
+    )
+
+
+def test_unknown_rule_in_suppression_flagged(tmp_path):
+    p = tmp_path / "unknown.py"
+    p.write_text("x = 1  # ballista-lint: disable=no-such-rule -- why\n")
+    findings = analyze_file(str(p))
+    assert any(
+        f.rule == "lint-usage" and "unknown rule" in f.message for f in findings
+    )
+
+
+# -- CLI / cache / json ------------------------------------------------------
+
+def test_json_output_and_cache_roundtrip(tmp_path):
+    work = tmp_path / "pkg" / "ballista_tpu" / "ops"
+    work.mkdir(parents=True)
+    shutil.copy(FIXTURES / "readback_bad.py", work / "mod.py")
+    cache = tmp_path / "cache.json"
+
+    def run():
+        proc = subprocess.run(
+            [sys.executable, "-m", "dev.analysis", str(work), "--json",
+             "--cache-file", str(cache)],
+            cwd=str(REPO), capture_output=True, text=True,
+        )
+        return proc.returncode, json.loads(proc.stdout)
+
+    rc1, out1 = run()
+    assert rc1 == 1 and not out1["ok"]
+    assert out1["stats"]["cache_hits"] == 0
+    assert {f["rule"] for f in out1["findings"]} == {"readback-discipline"}
+    assert all(
+        {"rule", "path", "line", "col", "message"} <= set(f) for f in out1["findings"]
+    )
+
+    rc2, out2 = run()  # warm: same findings, served from cache
+    assert rc2 == 1
+    assert out2["stats"]["cache_hits"] == out2["stats"]["files"] == 1
+    assert out2["findings"] == out1["findings"]
+
+    # an edit invalidates the entry and flips the verdict
+    text = (work / "mod.py").read_text().replace(
+        "return np.asarray(out)  # unrecorded d2h transfer",
+        "from ballista_tpu.ops.runtime import record_readback\n"
+        "    arr = np.asarray(out)\n"
+        "    record_readback(arr.shape[-1], arr.nbytes)\n"
+        "    return arr",
+    ).replace(
+        "return np.asarray(run(cols, aux))  # unrecorded d2h transfer",
+        "from ballista_tpu.ops.runtime import readback\n"
+        "    return readback(run(cols, aux))",
+    )
+    (work / "mod.py").write_text(text)
+    os.utime(work / "mod.py")
+    rc3, out3 = run()
+    assert rc3 == 0 and out3["ok"], out3["findings"]
+
+
+def test_suppression_budget_enforced(tmp_path):
+    p = tmp_path / "budget.py"
+    lines = ["# ballista-lint: path=ballista_tpu/ops/fixture_budget.py"]
+    for i in range(6):
+        lines.append(f"x{i} = {i}  # ballista-lint: disable=lint-usage -- r{i}")
+    p.write_text("\n".join(lines) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dev.analysis", str(p), "--no-cache", "--json"],
+        cwd=str(REPO), capture_output=True, text=True,
+    )
+    out = json.loads(proc.stdout)
+    assert out["over_suppression_budget"] and proc.returncode == 1
