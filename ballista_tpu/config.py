@@ -92,7 +92,10 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_SCAN_CACHE: "true",
     BALLISTA_SCAN_CACHE_CAP: str(4 << 30),
     BALLISTA_TPU_PER_OP: "false",
-    BALLISTA_TPU_DEVICE_JOIN: "false",
+    # on by default since the M:N multiplicity kernel (ops/join.py): the
+    # device join is bit-identical to the host oracle for any build-key
+    # multiplicity and steps aside with a reason past the admission tiers
+    BALLISTA_TPU_DEVICE_JOIN: "true",
     BALLISTA_TPU_FUSE_VOLATILE: "false",
     BALLISTA_TPU_SPMD: "false",
     BALLISTA_TPU_COALESCE_AGG: "auto",

@@ -1,13 +1,24 @@
-"""Device join kernel: sort + vectorized binary search.
+"""Device join kernel: sort + paired binary search with M:N multiplicity.
 
-The TPU-native lowering of the PK-FK hash join (every TPC-H join): build-side
-key codes are sorted on device, probe keys binary-search them
-(jnp.searchsorted is branch-free and vectorizes on the VPU), equality checks
-produce a match mask, and the matched build-row indices gather the build
-columns. Requires unique build keys (primary keys) — the probe side keeps its
-cardinality, so output shapes stay static. Duplicate build keys fall back to
-the host sort-merge join (physical/joinutil.py), which shares the same key
-normalization.
+The TPU-native lowering of the hash join (every TPC-H join, primary-key or
+not): build-side key codes are sorted on device ONCE (stable, so equal keys
+keep build-row order), each probe key binary-searches the sorted plane twice
+(jnp.searchsorted side='left'/'right' — branch-free, vectorizes on the VPU)
+and the difference is that probe's match run-length. Duplicate build keys no
+longer decline: run-lengths exclusive-scan into per-probe output offsets on
+the host flatten, and matches materialize through a bounded-width gather
+whose static width is the smallest admission tier
+(ops/kernels.py::JOIN_MULTIPLICITY_TIERS) covering the observed maximum
+multiplicity, keeping every program shape static. Shapes past the top tier
+(or past the gather element cap) step aside to the host sort-merge join
+(physical/joinutil.py) with a recorded reason; both paths share the same
+key normalization and emit matches in the same order — probe-major, build
+rows in stable sorted order within a probe key — so device results are
+bit-identical to the host oracle, multiplicity and order included.
+
+Every decline flows through the canonical kernels helpers AND
+runtime.record_join_path, so bench.py's per-config join-path counters
+(device / step_aside / host_fallback, with reasons) stay truthful.
 """
 
 from __future__ import annotations
@@ -18,56 +29,127 @@ from typing import Optional, Tuple
 import numpy as np
 import pyarrow as pa
 
-from ballista_tpu.ops.runtime import bucket_rows, pad_to, readback
+from ballista_tpu.ops.runtime import (
+    bucket_rows,
+    pad_to,
+    readback,
+    record_join_path,
+)
+
+_PAD_CODE = np.int32(2**31 - 1)  # sorts last, never matches a valid probe
+
+
+def match_runs(sorted_codes, probe_codes):
+    """Per-probe match run over a sorted build-code plane (traced):
+    paired searchsorted left/right -> (starts, counts), both int32. Null
+    probe codes (-1) and probe pad slots yield count 0; null build codes
+    sort below every valid probe code and build pad codes above, so
+    [starts, ends) never spans either. ONE source of truth shared by the
+    single-chip kernel below and the SPMD mesh program (spmd_join.py) —
+    the two device join paths must never drift."""
+    import jax.numpy as jnp
+
+    starts = jnp.searchsorted(sorted_codes, probe_codes, side="left")
+    ends = jnp.searchsorted(sorted_codes, probe_codes, side="right")
+    counts = jnp.where(probe_codes >= 0, ends - starts, 0)
+    return starts.astype(jnp.int32), counts.astype(jnp.int32)
+
+
+def gather_matches(values, starts, counts, width: int):
+    """Bounded-width gather (traced): [P, width] of values[starts + j],
+    masked to -1 past each probe's run length. Shared with the mesh
+    program, like match_runs."""
+    import jax.numpy as jnp
+
+    n = values.shape[0]
+    j = jnp.arange(width, dtype=jnp.int32)
+    idx = jnp.clip(starts[:, None] + j[None, :], 0, n - 1)
+    return jnp.where(j[None, :] < counts[:, None], values[idx], -1)
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel():
+def _runs_kernel():
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def join(build_codes, probe_codes, n_build):
-        order = jnp.argsort(build_codes)
-        sorted_b = build_codes[order]
-        pos = jnp.searchsorted(sorted_b, probe_codes)
-        pos_c = jnp.clip(pos, 0, build_codes.shape[0] - 1)
-        match = jnp.logical_and(
-            sorted_b[pos_c] == probe_codes, pos < n_build
-        )
-        build_idx = jnp.where(match, order[pos_c], -1)
-        return build_idx
+    def runs(build_codes, probe_codes):
+        # stable: equal build keys keep original row order, matching the
+        # host oracle's kind="stable" argsort (bit-equal output order)
+        order = jnp.argsort(build_codes, stable=True)
+        starts, counts = match_runs(build_codes[order], probe_codes)
+        return order, starts, counts
 
-    return join
+    return runs
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_kernel(width: int):
+    import jax
+
+    @jax.jit
+    def gather(order, starts, counts):
+        return gather_matches(order, starts, counts, width)
+
+    return gather
+
+
+def _decline(kind: str, reason: str) -> None:
+    """Join decline: record the path for bench's per-config join counters
+    (`kind` distinguishes admission-tier "step_aside" declines from other
+    "host_fallback" declines), then route through the canonical
+    host_fallback helper — either way the join leaves the device entirely,
+    so tracing must count a fallback, not a mid-ladder step-aside."""
+    from ballista_tpu.ops.kernels import host_fallback
+
+    record_join_path(kind, reason)
+    return host_fallback(reason)
 
 
 def device_join_indices(
     build_codes: np.ndarray, probe_codes: np.ndarray
-) -> Optional[Tuple[np.ndarray, np.ndarray]]:
-    """Per-probe matched build index (-1 = no match) computed on device.
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """M:N inner-join row selections computed on device.
 
-    Returns (build_idx, match_mask) or None when the device path declines
-    (duplicate build keys, code range too wide for int32).
+    Returns (build_idx, probe_idx, counts): flat int64 selections realizing
+    every (build, probe) key match — probe-major, build rows in stable
+    sorted order within a probe key, bit-identical to the host oracle's
+    ``join_indices(..., "inner")`` — plus per-probe match run-lengths
+    (LEFT-join and membership-count consumers read unmatched probes off
+    ``counts == 0``). None when the device path declines (empty side, code
+    range too wide for int32, multiplicity past the top admission tier);
+    every decline carries a recorded reason.
     """
     import jax.numpy as jnp
 
+    from ballista_tpu.ops.kernels import join_multiplicity_tier
+
     nb, np_ = len(build_codes), len(probe_codes)
     if nb == 0 or np_ == 0:
-        return None
-    if len(np.unique(build_codes)) != nb:
-        return None  # duplicate build keys -> expansion needs dynamic shapes
-    hi = max(int(build_codes.max()), int(probe_codes.max()) if np_ else 0)
+        return _decline("host_fallback", "empty join side")
+    hi = max(int(build_codes.max()), int(probe_codes.max()))
     if hi >= 2**31 - 2:
-        return None
-    pad_code = np.int32(2**31 - 1)  # sorts last, never matches a probe
+        return _decline("host_fallback", "join key codes exceed int32")
     b = jnp.asarray(
-        pad_to(build_codes.astype(np.int32), bucket_rows(nb, 16), pad_code)
+        pad_to(build_codes.astype(np.int32), bucket_rows(nb, 16), _PAD_CODE)
     )
-    # null probe keys (-1) must not match; -1 would binary-search below all
-    # valid codes and compare unequal, which is already a non-match
+    # null probe keys (-1) binary-search below all valid codes and compare
+    # unequal — already a non-match; pads reuse the same sentinel
     p = jnp.asarray(pad_to(probe_codes.astype(np.int32), bucket_rows(np_, 16), -1))
-    out = readback(_kernel()(b, p, nb))[:np_]
-    return out, out >= 0
+    order, starts, counts = _runs_kernel()(b, p)
+    counts_h = readback(counts)[:np_]
+    max_mult = int(counts_h.max())
+    tier, why = join_multiplicity_tier(max_mult, len(p))
+    if tier is None:
+        return _decline("step_aside", why)
+    mat = readback(_gather_kernel(tier)(order, starts, counts), rows=np_)[:np_]
+    # host flatten: the run-length exclusive scan is implicit in the
+    # row-major compaction (probe-major, slot order within each probe)
+    keep = np.arange(tier, dtype=np.int32)[None, :] < counts_h[:, None]
+    build_idx = mat[keep].astype(np.int64)
+    probe_idx = np.repeat(np.arange(np_, dtype=np.int64), counts_h)
+    record_join_path("device")
+    return build_idx, probe_idx, counts_h.astype(np.int64)
 
 
 def try_device_inner_join(
@@ -77,7 +159,8 @@ def try_device_inner_join(
     probe_keys: list,
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Returns (build_idx, probe_idx) row selections realizing the inner
-    join, or None if the device path declines."""
+    join — duplicate build keys expand to their full multiplicity — or None
+    if the device path declines."""
     from ballista_tpu.physical.joinutil import combined_key_codes
 
     bcodes, pcodes = combined_key_codes(
@@ -87,6 +170,5 @@ def try_device_inner_join(
     res = device_join_indices(bcodes, pcodes)
     if res is None:
         return None
-    build_idx, mask = res
-    probe_rows = np.nonzero(mask)[0].astype(np.int64)
-    return build_idx[mask].astype(np.int64), probe_rows
+    build_idx, probe_idx, _counts = res
+    return build_idx, probe_idx

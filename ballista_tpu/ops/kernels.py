@@ -64,6 +64,48 @@ def step_aside(reason: str) -> None:
     logging.getLogger("ballista.tpu").debug("ladder step-aside: %s", reason)
     return None
 
+
+# -- M:N join admission ------------------------------------------------------
+# Bounded-width gather tiers for the device hash join (ops/join.py and the
+# SPMD mesh join, parallel/spmd_join.py): duplicate build keys expand each
+# probe into up to max-multiplicity matched rows, and the static gather
+# width is the smallest tier covering the observed maximum run-length, so
+# XLA compiles a bounded set of gather programs (same recompilation-control
+# idea as bucket_rows). Shapes past the top tier — or whose padded [probe
+# slots x width] materialization would exceed the element cap — step aside
+# to the host sort-merge join with a recorded reason.
+JOIN_MULTIPLICITY_TIERS = (1, 4, 16, 64, 256)
+# padded gather elements (probe slots x width); past this the bounded-width
+# materialization + its d2h readback cost more than the host join it
+# replaces (2^26 int32 elements = 256 MiB on the wire)
+JOIN_GATHER_CAP = 1 << 26
+
+
+def join_multiplicity_tier(
+    max_mult: int, probe_slots: int
+) -> Tuple[Optional[int], Optional[str]]:
+    """Admission for the M:N bounded-width gather: (tier, None) with the
+    smallest static width covering `max_mult`, or (None, reason) when the
+    shape exceeds the ladder — callers record the reason (runtime.
+    record_join_path) and step aside to the host join."""
+    for tier in JOIN_MULTIPLICITY_TIERS:
+        if max_mult <= tier:
+            # width 1 transfers exactly the one-int32-per-probe plane the
+            # pre-M:N kernel always read back uncapped — the cap guards the
+            # bounded-width padding amplification, which only exists past
+            # width 1 (capping width 1 would regress large unique-key joins
+            # to the host for no readback saving)
+            if tier > 1 and probe_slots * tier > JOIN_GATHER_CAP:
+                return None, (
+                    f"M:N gather {probe_slots}x{tier} exceeds the "
+                    f"{JOIN_GATHER_CAP}-element cap"
+                )
+            return tier, None
+    return None, (
+        f"build-key multiplicity {max_mult} exceeds top tier "
+        f"{JOIN_MULTIPLICITY_TIERS[-1]}"
+    )
+
 # executor task threads run concurrently: lookup/evict/insert must be one
 # atomic section or two threads can each build (and pin) the same stage.
 # (Tests reach in to clear these between cases — cross-file accesses are

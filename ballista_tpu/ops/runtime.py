@@ -709,3 +709,33 @@ def readback_stats(reset: bool = False) -> Dict[str, int]:
             for k in _readback_totals:
                 _readback_totals[k] = 0
     return out
+
+
+# accumulated join-path outcomes across join executions (bench.py reports
+# them per config): every device-join attempt lands in exactly one bucket —
+# "device" (the M:N kernel or the mesh program produced the result),
+# "step_aside" (the multiplicity/gather admission tier declined, host join
+# ran instead), or "host_fallback" (any other decline or error). Reasons are
+# counted verbatim so a bench row says WHY a join left the device path.
+_join_lock = threading.Lock()
+_join_paths: Dict[str, int] = {}  # path -> count; guarded-by: _join_lock
+_join_reasons: Dict[str, int] = {}  # "path: reason" -> count; guarded-by: _join_lock
+
+
+def record_join_path(path: str, reason: Optional[str] = None) -> None:
+    with _join_lock:
+        _join_paths[path] = _join_paths.get(path, 0) + 1
+        if reason:
+            key = f"{path}: {reason}"
+            _join_reasons[key] = _join_reasons.get(key, 0) + 1
+
+
+def join_path_stats(reset: bool = False) -> Dict[str, Dict[str, int]]:
+    """Snapshot of accumulated join-path counters: {"paths": {path: n},
+    "reasons": {"path: reason": n}}."""
+    with _join_lock:
+        out = {"paths": dict(_join_paths), "reasons": dict(_join_reasons)}
+        if reset:
+            _join_paths.clear()
+            _join_reasons.clear()
+    return out

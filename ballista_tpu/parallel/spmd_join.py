@@ -26,10 +26,18 @@ key type, composite keys, nulls -> -1 (never match). Coding is dense, so
 bucket ownership `splitmix(code) % n_dev` balances shards and codes fit
 int32 for the device sort.
 
+Duplicate build keys run ON the mesh: each shard computes per-probe match
+run-lengths with paired searchsorted (side='left'/'right') and materializes
+them through a bounded-width gather whose static width is the smallest
+admission tier (ops/kernels.py::JOIN_MULTIPLICITY_TIERS) covering the
+build side's observed maximum key multiplicity — the same M:N program
+shape as the single-chip device join (ops/join.py).
+
 Decline-to-host (the wrapped subplan is the untouched original subtree):
-non-INNER/LEFT join types, residual filters, duplicate non-null build
-keys (searchsorted yields one match; many-many multiplicity needs the
-host expansion), or any device error.
+non-INNER/LEFT join types, residual filters, multiplicity past the top
+admission tier (steps aside to the inline host join), or any device
+error. Every outcome is recorded via runtime.record_join_path so bench's
+per-config join counters stay truthful.
 """
 
 from __future__ import annotations
@@ -124,14 +132,29 @@ class SpmdJoinExec(ExecutionPlan):
                 "spmd.join_host_inline" if self._inline_host
                 else "spmd.join_mesh"
             )
+            if not self._inline_host:
+                from ballista_tpu.ops.runtime import record_join_path
+
+                record_join_path("device")
         except Exception:
             import logging
             import sys
 
-            from ballista_tpu.ops.runtime import UnsupportedOnDevice
+            from ballista_tpu.ops.runtime import (
+                UnsupportedOnDevice,
+                record_join_path,
+            )
 
             exc = sys.exc_info()[1]
             tracing.incr("spmd.join_host_fallback")
+            # reasoned declines carry their (bounded) reason text; arbitrary
+            # errors record only the exception type, or a long-lived
+            # executor's reason map would grow one key per distinct message
+            record_join_path(
+                "host_fallback",
+                f"mesh join: {exc}" if isinstance(exc, UnsupportedOnDevice)
+                else f"mesh join error: {type(exc).__name__}",
+            )
             if not isinstance(exc, UnsupportedOnDevice):
                 logging.getLogger("ballista.spmd").warning(
                     "mesh join failed, host fallback: %s", exc
@@ -186,7 +209,9 @@ class SpmdJoinExec(ExecutionPlan):
         )
         if left.num_rows == 0 or right.num_rows == 0:
             # no mesh work to do; join inline over what was collected
-            return self._host_join_collected(left, right, bcodes, pcodes)
+            return self._host_join_collected(
+                left, right, bcodes, pcodes, reason="empty join side"
+            )
         hi = max(int(bcodes.max()), int(pcodes.max()))
         if hi >= (1 << 31):
             # dense re-map: distinct count <= row count < 2^31. _refactorize
@@ -196,15 +221,15 @@ class SpmdJoinExec(ExecutionPlan):
             bcodes, pcodes, _ = _refactorize(bcodes, pcodes)
             bcodes = np.where(bnull, -1, bcodes)
             pcodes = np.where(pnull, -1, pcodes)
-        # searchsorted yields one match per probe: duplicate build keys
-        # (many-many multiplicity) and empty sides skip the mesh — but the
-        # sides are already collected and coded, so join INLINE on the host
-        # (vectorized join_indices) instead of re-executing the subplan with
-        # its materialized shuffles
+        # build-key multiplicity bounds the static gather width: the staging
+        # pass below already touches every code, so the max duplicate count
+        # comes from one host bincount-equivalent over the valid build keys
         valid_b = bcodes >= 0
-        uniq = np.unique(bcodes[valid_b])
-        if len(uniq) != int(valid_b.sum()):
-            return self._host_join_collected(left, right, bcodes, pcodes)
+        if valid_b.any():
+            _, dup_counts = np.unique(bcodes[valid_b], return_counts=True)
+            max_mult = int(dup_counts.max())
+        else:
+            max_mult = 0
 
         # ---- host staging: bucket (code, rowid) by key ownership ------
         def stage_side(codes: np.ndarray):
@@ -238,8 +263,24 @@ class SpmdJoinExec(ExecutionPlan):
         lc, lr, C_l = stage_side(bcodes)
         pc_, pr, C_p = stage_side(pcodes)
 
+        # admission: smallest static gather width covering the build-key
+        # multiplicity; past the ladder the mesh declines to the inline
+        # host join (the sides are already collected and coded — no subplan
+        # re-execution, no shuffle materialization). host_fallback, not
+        # step_aside: the join leaves the device entirely, there is no next
+        # device rung — only bench's join_paths kind keeps the admission-
+        # tier distinction
+        from ballista_tpu.ops.kernels import host_fallback, join_multiplicity_tier
+
+        width, why = join_multiplicity_tier(max_mult, n_dev * n_dev * C_p)
+        if width is None:
+            host_fallback(why)
+            return self._host_join_collected(
+                left, right, bcodes, pcodes, kind="step_aside", reason=why
+            )
+
         program = self._get_program(
-            mesh, n_dev, C_l * n_dev, C_p * n_dev,
+            mesh, n_dev, C_l * n_dev, C_p * n_dev, width,
             want_left_bitmap=join.join_type == JoinType.LEFT,
         )
         outs = program(
@@ -247,12 +288,15 @@ class SpmdJoinExec(ExecutionPlan):
         )
         # the matching plane comes back over d2h: account for it, or the
         # bench readback fields undercount the mesh-join path
-        matched_lrow = readback(outs[0])  # [n_dev * B_p] int32, -1 = no match
+        # matched build rows per probe slot [n_dev * B_p, width], -1 = no match
+        matched = readback(outs[0], rows=outs[0].shape[0])
         recv_prow = readback(outs[1])  # [n_dev * B_p] int32, -1 = pad
 
-        pairs = (matched_lrow >= 0) & (recv_prow >= 0)
-        lidx = matched_lrow[pairs].astype(np.int64)
-        ridx = recv_prow[pairs].astype(np.int64)
+        # flatten probe-slot-major: pad/null slots have all-(-1) rows, so
+        # their repeat count is 0 and they vanish from the selection
+        hits = matched >= 0
+        lidx = matched[hits].astype(np.int64)
+        ridx = np.repeat(recv_prow, hits.sum(axis=1)).astype(np.int64)
         left_out = take_table(left, lidx)
         right_out = take_table(right, ridx)
         if join.join_type == JoinType.LEFT:
@@ -273,14 +317,17 @@ class SpmdJoinExec(ExecutionPlan):
     def _host_join_collected(
         self, left: pa.Table, right: pa.Table,
         bcodes: np.ndarray, pcodes: np.ndarray,
+        kind: str = "host_fallback", reason: str = "",
     ) -> pa.Table:
         """Vectorized host join over the already-collected sides — the
-        decline path for shapes the mesh program cannot take (duplicate
-        build keys, empty sides). Costs one collect + one join pass, like
-        the broadcast join these plans had before SPMD co-partitioning; no
-        shuffle materialization, no re-execution."""
+        decline path for shapes the mesh program cannot take (multiplicity
+        past the admission tiers, empty sides). Costs one collect + one
+        join pass, like the broadcast join these plans had before SPMD
+        co-partitioning; no shuffle materialization, no re-execution."""
+        from ballista_tpu.ops.runtime import record_join_path
         from ballista_tpu.physical.joinutil import join_indices, take_table
 
+        record_join_path(kind, reason or None)
         self._inline_host = True
         how = "inner" if self.subplan.join_type == JoinType.INNER else "left"
         li, ri = join_indices(bcodes, pcodes, how)
@@ -291,18 +338,21 @@ class SpmdJoinExec(ExecutionPlan):
         )
 
     # ------------------------------------------------------------------
-    def _get_program(self, mesh, n_dev: int, B_l: int, B_p: int,
+    def _get_program(self, mesh, n_dev: int, B_l: int, B_p: int, width: int,
                      want_left_bitmap: bool):
-        """shard_map program, jitted once per (capacities, join shape):
-        all_to_all exchange of (code, rowid) for both sides, then per-shard
-        sort + searchsorted matching. Outputs stay sharded (P('data'));
-        every shard owns a disjoint key range, so its matches are global."""
-        key = (n_dev, B_l, B_p, want_left_bitmap)
+        """shard_map program, jitted once per (capacities, gather width,
+        join shape): all_to_all exchange of (code, rowid) for both sides,
+        then per-shard sort + paired searchsorted run-lengths + a
+        bounded-width gather (M:N multiplicity). Outputs stay sharded
+        (P('data')); every shard owns a disjoint key range, so its matches
+        are global."""
+        key = (n_dev, B_l, B_p, width, want_left_bitmap)
         if self._program_key == key:
             return self._program
 
         import jax
         import jax.numpy as jnp
+        from ballista_tpu.ops.join import gather_matches, match_runs
         from ballista_tpu.parallel.meshcompat import shard_map
         from jax.sharding import PartitionSpec as P
 
@@ -317,18 +367,22 @@ class SpmdJoinExec(ExecutionPlan):
             # materialized shuffle
             lcode, lrow = a2a(lcode), a2a(lrow)
             pcode, prow = a2a(pcode), a2a(prow)
-            order = jnp.argsort(lcode)
+            order = jnp.argsort(lcode, stable=True)
             sl = lcode[order]
             slrow = lrow[order]
-            idx = jnp.searchsorted(sl, pcode)
-            idx_c = jnp.clip(idx, 0, B_l - 1)
-            eq = (sl[idx_c] == pcode) & (pcode >= 0)
-            matched_lrow = jnp.where(eq, slrow[idx_c], -1)
-            outs = [matched_lrow, prow]
+            # shared M:N core (ops/join.py): per-probe run-lengths +
+            # bounded-width gather of the matched build row ids
+            starts, counts = match_runs(sl, pcode)
+            matched = gather_matches(slrow, starts, counts, width)
+            outs = [matched, prow]
             if want_left_bitmap:
-                hit_sorted = (
-                    jnp.zeros(B_l, dtype=bool).at[idx_c].max(eq)
-                )
+                # a left slot is matched iff its key occurs among this
+                # shard's probe codes — binary search over the sorted probe
+                # plane (duplicate-safe, unlike a one-match scatter)
+                sp = jnp.sort(pcode)
+                lo = jnp.searchsorted(sp, sl, side="left")
+                hi = jnp.searchsorted(sp, sl, side="right")
+                hit_sorted = (hi > lo) & (sl >= 0)
                 lmatched = jnp.zeros(B_l, dtype=bool).at[order].set(hit_sorted)
                 outs.extend([lmatched, lrow])
             return tuple(outs)

@@ -111,8 +111,9 @@ class HashJoinExec(ExecutionPlan):
         probe = collect_partition(self.right, partition, ctx)
         if (self.join_type == JoinType.INNER and ctx.backend == "tpu"
                 and ctx.config.tpu_device_join()):
-            # device PK-FK join: sorted binary search on TPU; declines (None)
-            # on duplicate build keys and falls through to the host join
+            # device M:N join: sorted paired binary search + bounded-width
+            # gather on TPU, duplicate build keys included; declines (None,
+            # always with a recorded reason) fall through to the host join
             from ballista_tpu.ops.join import try_device_inner_join
 
             res = try_device_inner_join(build, probe, left_keys, right_keys)

@@ -7,7 +7,9 @@ Prints ONE JSON line:
    "configs": [per-query rows for q1/q3/q5/q6/q10 at SF=1, q1/q3/q5/q6 at
                SF=10, the two taxi shapes, and q1/q3/q5/q6 at SF=100 when
                the dataset is on disk — each {"name", "sf", "tpu_ms",
-               "cpu_ms", "speedup"}]}
+               "cpu_ms", "speedup"} plus optional "ingest"/"readback"
+               accounting and "join_paths" (device / step_aside /
+               host_fallback counts with decline reasons)]}
 
 Reference baseline context: the reference publishes no numbers
 (BASELINE.md); the denominator here is this repo's own host Arrow path —
@@ -277,6 +279,43 @@ def _readback_snapshot() -> dict | None:
     }
 
 
+def _join_snapshot(iters: int = 1) -> dict | None:
+    """Drain the join-path accumulator (ops/runtime.py): how many joins ran
+    on the device path vs stepped aside at the multiplicity/gather admission
+    tiers vs fell back to the host join, with decline reasons, since the
+    last drain. Counts normalize to per-query numbers under the same
+    contract as _per_query (raw totals flagged per_query=false when the
+    timed loop was uneven). None when no join attempt touched the device
+    path (joinless query, or the host backend)."""
+    try:
+        from ballista_tpu.ops.runtime import join_path_stats
+
+        s = join_path_stats(reset=True)
+    except Exception:
+        return None
+    if not s.get("paths"):
+        return None
+    # ONE normalization contract with the readback fields: flatten the
+    # nested reasons map, run _per_query's divide-evenly-or-flag logic over
+    # paths + reasons jointly, then unflatten
+    prefix = "reasons\t"  # \t cannot occur in a path name
+    flat = dict(s["paths"])
+    for k, v in (s.get("reasons") or {}).items():
+        flat[prefix + k] = v
+    norm = _per_query(flat, iters)
+    out = {
+        k: v for k, v in norm.items()
+        if not k.startswith(prefix) and k != "per_query"
+    }
+    reasons = {
+        k[len(prefix):]: v for k, v in norm.items() if k.startswith(prefix)
+    }
+    if reasons:
+        out["reasons"] = reasons
+    out["per_query"] = norm["per_query"]
+    return out
+
+
 def _ingest_snapshot() -> dict | None:
     """Drain the ingest-timing accumulator (ops/runtime.py): scan/encode/
     upload seconds and the overlap fraction of the stage prepares since the
@@ -314,8 +353,10 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         run_once("tpu", sql, sf)  # warmup: compile + caches
         ingest = _ingest_snapshot()  # fresh prepares happen at warmup
         _readback_snapshot()  # drain: attribute readbacks to the timed runs
+        _join_snapshot()  # drain: attribute join paths to the timed runs
         t = min(run_once("tpu", sql, sf) for _ in range(iters))
         readback = _per_query(_readback_snapshot(), iters)
+        join_paths = _join_snapshot(iters)
         run_once("cpu", sql, sf)
         c = min(run_once("cpu", sql, sf) for _ in range(iters))
     except Exception as e:
@@ -340,6 +381,15 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         print(f"[readback] {name} sf={sf}: rows={readback['readback_rows']} "
               f"bytes={readback['readback_bytes']} "
               f"transfers={readback['readbacks']} ({unit})",
+              file=sys.stderr)
+    if join_paths is not None:
+        row["join_paths"] = join_paths
+        counts = {k: v for k, v in join_paths.items()
+                  if k not in ("reasons", "per_query")}
+        unit = ("per query" if join_paths.get("per_query")
+                else "TOTALS (uneven loop)")
+        print(f"[join] {name} sf={sf}: {counts} "
+              f"reasons={join_paths.get('reasons', {})} ({unit})",
               file=sys.stderr)
     print(f"[config] {name} sf={sf}: tpu={row['tpu_ms']}ms "
           f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x", file=sys.stderr)

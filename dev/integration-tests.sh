@@ -42,9 +42,11 @@ PY
 python -m benchmarks.compare --data "$DATA" \
     --queries q1 q3 q5 q6 q10 q12 --iterations 1 --engines host pyarrow --strict
 
-# strict gate on the fused Sort+Limit epilogue and the float-bits
-# bijection: these modules are the bit-exactness contract for the
-# O(limit) readback and q2's device path — a regression here must fail
-# the tier loudly, not vanish into a silent host fallback
+# strict gate on the fused Sort+Limit epilogue, the float-bits bijection,
+# and the M:N join multiplicity kernel: these modules are the bit-exactness
+# contract for the O(limit) readback, q2's device path, and duplicate-key
+# joins staying on device — a regression here must fail the tier loudly,
+# not vanish into a silent host fallback
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
-    tests/test_floatbits.py tests/test_topk_epilogue.py
+    tests/test_floatbits.py tests/test_topk_epilogue.py \
+    tests/test_join_multiplicity.py

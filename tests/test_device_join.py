@@ -12,22 +12,29 @@ from ballista_tpu.ops.join import device_join_indices
 def test_device_join_indices_basic():
     build = np.array([10, 3, 7, 1], dtype=np.int64)
     probe = np.array([7, 7, 2, 10, 1], dtype=np.int64)
-    build_idx, mask = device_join_indices(build, probe)
-    assert mask.tolist() == [True, True, False, True, True]
-    assert build_idx[mask].tolist() == [2, 2, 0, 3]
+    build_idx, probe_idx, counts = device_join_indices(build, probe)
+    assert counts.tolist() == [1, 1, 0, 1, 1]
+    assert build_idx.tolist() == [2, 2, 0, 3]
+    assert probe_idx.tolist() == [0, 1, 3, 4]
 
 
-def test_device_join_declines_duplicates():
+def test_device_join_expands_duplicates():
+    """The retired unique-build-key decline: duplicate build keys expand to
+    their full multiplicity, probe-major, build rows in original order."""
     build = np.array([5, 5, 6], dtype=np.int64)
-    probe = np.array([5], dtype=np.int64)
-    assert device_join_indices(build, probe) is None
+    probe = np.array([5, 6, 5], dtype=np.int64)
+    build_idx, probe_idx, counts = device_join_indices(build, probe)
+    assert counts.tolist() == [2, 1, 2]
+    assert build_idx.tolist() == [0, 1, 2, 0, 1]
+    assert probe_idx.tolist() == [0, 0, 1, 2, 2]
 
 
 def test_device_join_null_probe_keys():
     build = np.array([1, 2, 3], dtype=np.int64)
     probe = np.array([2, -1, 3], dtype=np.int64)  # -1 = null code
-    build_idx, mask = device_join_indices(build, probe)
-    assert mask.tolist() == [True, False, True]
+    _, probe_idx, counts = device_join_indices(build, probe)
+    assert counts.tolist() == [1, 0, 1]
+    assert probe_idx.tolist() == [0, 2]
 
 
 @pytest.mark.parametrize("n", [1000, 5000])
@@ -35,11 +42,14 @@ def test_device_join_vs_host_random(n):
     rng = np.random.default_rng(3)
     build = rng.permutation(n * 2)[:n].astype(np.int64)  # unique
     probe = rng.integers(0, n * 2, n * 3).astype(np.int64)
-    build_idx, mask = device_join_indices(build, probe)
+    build_idx, probe_idx, counts = device_join_indices(build, probe)
     lookup = {int(k): i for i, k in enumerate(build)}
+    hits = {int(p): int(b) for b, p in zip(build_idx, probe_idx)}
     for j in range(len(probe)):
-        want = lookup.get(int(probe[j]), -1)
-        assert build_idx[j] == want
+        want = lookup.get(int(probe[j]), None)
+        assert counts[j] == (0 if want is None else 1)
+        if want is not None:
+            assert hits[j] == want
 
 
 def _tpch_join_sql():

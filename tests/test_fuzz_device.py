@@ -259,6 +259,87 @@ def test_fuzz_aggregate_over_join(tmp_path, seed):
              ctxs["cpu"].sql(sql).collect(), sql)
 
 
+def _dup_key_build(rng, shape: str):
+    """Build-side key column with controlled duplicate-key structure.
+
+    Shapes (ROADMAP "outer joins with duplicate keys" fuzzer slice):
+    - zipf: Zipf-skewed duplicate counts clipped inside the admission tiers
+      (the heaviest device-admissible skew);
+    - all_dup: every row carries ONE key (multiplicity == num_rows);
+    - monster: mostly-unique keys plus one key duplicated past the top
+      tier, forcing the step-aside path (results must still be exact);
+    - uniform: modest uniform duplication (the common case)."""
+    from ballista_tpu.ops.kernels import JOIN_MULTIPLICITY_TIERS
+
+    top = JOIN_MULTIPLICITY_TIERS[-1]
+    nk = int(rng.integers(30, 400))
+    if shape == "zipf":
+        counts = np.minimum(rng.zipf(1.5, nk), top)
+        keys = np.repeat(np.arange(nk, dtype=np.int64), counts)
+    elif shape == "all_dup":
+        keys = np.full(int(rng.integers(2, min(top, 150))), 7, dtype=np.int64)
+    elif shape == "monster":
+        keys = np.concatenate([
+            np.arange(nk, dtype=np.int64),
+            np.full(top + int(rng.integers(1, 50)), 3, dtype=np.int64),
+        ])
+    else:  # uniform
+        keys = np.repeat(
+            np.arange(nk, dtype=np.int64), rng.integers(1, 6, nk)
+        )
+    rng.shuffle(keys)
+    return keys
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_duplicate_key_joins(tmp_path, seed):
+    """Differential duplicate-key join sweep: the M:N device kernel (INNER,
+    build side with duplicate keys) and the host LEFT join must agree with
+    the cpu backend bit-for-bit — multiplicity, order, and null padding
+    included. Own rng streams (12000+/13000+ seeds), so every baseline
+    generator above stays byte-identical."""
+    rng = np.random.default_rng(12000 + seed)
+    prng = np.random.default_rng(13000 + seed)
+    _fresh()
+    shape = str(rng.choice(["zipf", "all_dup", "monster", "uniform"]))
+    bkeys = _dup_key_build(rng, shape)
+    nb = len(bkeys)
+    # ~5% null build keys (nulls must never match, not even each other)
+    bnull = rng.random(nb) < 0.05
+    build = pa.table(
+        {
+            "bk": pa.array(
+                [None if isnull else int(v) for v, isnull in zip(bkeys, bnull)],
+                type=pa.int64(),
+            ),
+            "bv": pa.array(np.round(rng.uniform(-100, 100, nb), 3)),
+            "bs": pa.array([f"b{v % 11}" for v in range(nb)]),
+        }
+    )
+    np_rows = int(prng.integers(500, 8000))
+    pkeys = prng.integers(-1, int(bkeys.max()) + 20, np_rows)
+    probe = pa.table(
+        {
+            "pk": pa.array(
+                [None if v < 0 else int(v) for v in pkeys], type=pa.int64()
+            ),
+            "pv": pa.array(np.round(prng.uniform(0, 50, np_rows), 3)),
+        }
+    )
+    how = str(rng.choice(["inner", "left"]))
+    out = {}
+    for backend in ("tpu", "cpu"):
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        ctx.register_record_batches("b", build, n_partitions=1)
+        ctx.register_record_batches("p", probe, n_partitions=1)
+        df = ctx.table("b").join(ctx.table("p"), ["bk"], ["pk"], how=how)
+        out[backend] = df.collect()
+    assert out["tpu"].schema == out["cpu"].schema, (shape, how)
+    assert out["tpu"].to_pylist() == out["cpu"].to_pylist(), (shape, how)
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_fuzz_float_extrema_minmax(tmp_path, seed):
     """Dedicated float-extrema sweep: MIN/MAX over NaN/±0/subnormal/

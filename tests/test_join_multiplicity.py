@@ -1,0 +1,208 @@
+"""M:N duplicate-key device join (ops/join.py) vs the host join oracle.
+
+The correctness bar for the retired unique-build-key decline: results must
+be BIT-identical to physical/joinutil.join_indices — row multiplicity and
+stable order within a probe key included — and overflow shapes must decline
+with a recorded reason, never produce wrong rows. The end-to-end case runs
+a q3-shaped duplicate-build-key query through both backends and asserts the
+device path actually engaged (join-path counter says "device", not
+"host_fallback")."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.engine import ExecutionContext
+from ballista_tpu.ops.join import device_join_indices
+from ballista_tpu.ops.kernels import (
+    JOIN_GATHER_CAP,
+    JOIN_MULTIPLICITY_TIERS,
+    join_multiplicity_tier,
+)
+from ballista_tpu.ops.runtime import join_path_stats
+from ballista_tpu.physical.joinutil import join_indices
+
+
+def _assert_matches_oracle(build, probe):
+    res = device_join_indices(build, probe)
+    assert res is not None, "device path declined a shape inside the tiers"
+    build_idx, probe_idx, counts = res
+    bi_o, pi_o = join_indices(build, probe, "inner")
+    # bit-equality: same matches, same multiplicity, same order
+    assert build_idx.tolist() == bi_o.tolist()
+    assert probe_idx.tolist() == pi_o.tolist()
+    # counts are the per-probe run-lengths (membership-count consumers)
+    np.testing.assert_array_equal(
+        counts, np.bincount(pi_o, minlength=len(probe))
+    )
+
+
+# -- property tests ----------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 2, 7, 33, 200])
+def test_random_multiplicities(k):
+    """Every build key duplicated a random 1..k times; probes hit, miss,
+    and null."""
+    rng = np.random.default_rng(100 + k)
+    keys = np.arange(40, dtype=np.int64)
+    build = np.repeat(keys, rng.integers(1, k + 1, len(keys)))
+    rng.shuffle(build)
+    probe = rng.integers(-1, 55, 3000).astype(np.int64)
+    _assert_matches_oracle(build, probe)
+
+
+def test_empty_probe_runs():
+    """No probe matches anything: zero-width result, device still runs."""
+    build = np.array([5, 5, 5, 9], dtype=np.int64)
+    probe = np.array([1, 2, 3], dtype=np.int64)
+    join_path_stats(reset=True)
+    build_idx, probe_idx, counts = device_join_indices(build, probe)
+    assert len(build_idx) == len(probe_idx) == 0
+    assert counts.tolist() == [0, 0, 0]
+    assert join_path_stats(reset=True)["paths"] == {"device": 1}
+
+
+def test_nulls_on_both_sides():
+    """Null keys (-1 codes) never match — not even each other."""
+    build = np.array([-1, -1, 3, 3], dtype=np.int64)
+    probe = np.array([-1, 3, -1], dtype=np.int64)
+    _assert_matches_oracle(build, probe)
+
+
+def test_all_duplicate_single_key():
+    build = np.full(37, 4, dtype=np.int64)
+    probe = np.array([4, 4, 5], dtype=np.int64)
+    build_idx, probe_idx, counts = device_join_indices(build, probe)
+    assert counts.tolist() == [37, 37, 0]
+    assert build_idx.tolist() == list(range(37)) * 2
+    _assert_matches_oracle(build, probe)
+
+
+def test_zipf_skewed_build():
+    """Zipf-skewed duplicate counts, clipped at the top tier so the shape
+    is admissible — the heaviest admissible skew."""
+    rng = np.random.default_rng(9)
+    counts = np.minimum(rng.zipf(1.4, 97), JOIN_MULTIPLICITY_TIERS[-1])
+    build = np.repeat(np.arange(97, dtype=np.int64), counts)
+    rng.shuffle(build)
+    probe = rng.integers(0, 120, 8000).astype(np.int64)
+    _assert_matches_oracle(build, probe)
+
+
+# -- admission / overflow ----------------------------------------------------
+
+def test_tier_ladder():
+    assert join_multiplicity_tier(0, 1024) == (1, None)
+    assert join_multiplicity_tier(1, 1024) == (1, None)
+    assert join_multiplicity_tier(2, 1024) == (4, None)
+    assert join_multiplicity_tier(256, 1024) == (256, None)
+    tier, why = join_multiplicity_tier(257, 1024)
+    assert tier is None and "multiplicity" in why
+    tier, why = join_multiplicity_tier(64, JOIN_GATHER_CAP)
+    assert tier is None and "cap" in why
+    # width 1 is exempt from the cap: it transfers exactly the
+    # one-int32-per-probe plane the pre-M:N kernel always read back
+    assert join_multiplicity_tier(1, JOIN_GATHER_CAP * 4) == (1, None)
+
+
+def test_overflow_declines_with_reason():
+    mult = JOIN_MULTIPLICITY_TIERS[-1] + 1
+    build = np.full(mult, 1, dtype=np.int64)
+    probe = np.array([1, 2], dtype=np.int64)
+    join_path_stats(reset=True)
+    assert device_join_indices(build, probe) is None
+    stats = join_path_stats(reset=True)
+    assert stats["paths"] == {"step_aside": 1}
+    assert any("exceeds top tier" in r for r in stats["reasons"])
+
+
+def test_empty_side_declines_with_reason():
+    join_path_stats(reset=True)
+    assert device_join_indices(
+        np.empty(0, np.int64), np.array([1], dtype=np.int64)
+    ) is None
+    stats = join_path_stats(reset=True)
+    assert stats["paths"] == {"host_fallback": 1}
+    assert any("empty join side" in r for r in stats["reasons"])
+
+
+# -- end to end --------------------------------------------------------------
+
+def _q3_shaped_tables():
+    """q3 shape: orders (build side, MANY orders per customer) joined to
+    customer on a non-unique build key."""
+    rng = np.random.default_rng(42)
+    n_cust = 300
+    customer = pa.table(
+        {
+            "c_custkey": pa.array(np.arange(n_cust), type=pa.int64()),
+            "c_name": pa.array([f"Customer#{i:09d}" for i in range(n_cust)]),
+        }
+    )
+    # Zipf-skewed order counts per customer (some have dozens of orders;
+    # +40 custkeys fall outside the customer table and never match),
+    # clipped under the top admission tier so the shape stays on device
+    per_cust = np.minimum(rng.zipf(1.3, n_cust + 40), 120)
+    o_custkey = np.repeat(
+        np.arange(n_cust + 40, dtype=np.int64), per_cust
+    )
+    rng.shuffle(o_custkey)
+    n_ord = len(o_custkey)
+    orders = pa.table(
+        {
+            "o_orderkey": pa.array(np.arange(n_ord), type=pa.int64()),
+            "o_custkey": pa.array(o_custkey),
+            "o_totalprice": pa.array(
+                np.round(rng.uniform(1000, 400000, n_ord), 2)
+            ),
+        }
+    )
+    return customer, orders
+
+
+def test_q3_shaped_duplicate_build_key_runs_on_device():
+    customer, orders = _q3_shaped_tables()
+    sql = (
+        "select o_orderkey, c_name, o_totalprice from orders, customer "
+        "where o_custkey = c_custkey"
+    )
+    out = {}
+    for backend in ("tpu", "cpu"):
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        ctx.register_record_batches("customer", customer, n_partitions=1)
+        ctx.register_record_batches("orders", orders, n_partitions=1)
+        if backend == "tpu":
+            join_path_stats(reset=True)
+            out[backend] = ctx.sql(sql).collect()
+            stats = join_path_stats(reset=True)
+            # acceptance: the duplicate-build-key join ran ON DEVICE
+            assert stats["paths"].get("device", 0) >= 1, stats
+            assert "host_fallback" not in stats["paths"], stats
+            assert "step_aside" not in stats["paths"], stats
+        else:
+            out[backend] = ctx.sql(sql).collect()
+    # bit-equality INCLUDING row order (no ORDER BY: output order is the
+    # join emission order, probe-major with stable build order per key)
+    assert out["tpu"].to_pylist() == out["cpu"].to_pylist()
+
+
+def test_left_dataframe_join_duplicate_build_matches_host():
+    """LEFT joins take the host path on both backends today; duplicate
+    build keys must agree exactly (regression guard for the counts-based
+    LEFT lowering that q13/q22 membership counting will build on)."""
+    customer, orders = _q3_shaped_tables()
+    out = {}
+    for backend in ("tpu", "cpu"):
+        ctx = ExecutionContext(
+            BallistaConfig({"ballista.executor.backend": backend})
+        )
+        ctx.register_record_batches("o", orders, n_partitions=1)
+        ctx.register_record_batches("c", customer, n_partitions=1)
+        df = ctx.table("o").join(
+            ctx.table("c"), ["o_custkey"], ["c_custkey"], how="left"
+        )
+        out[backend] = df.collect()
+    assert out["tpu"].to_pylist() == out["cpu"].to_pylist()

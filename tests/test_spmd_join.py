@@ -154,9 +154,9 @@ def test_string_and_composite_keys():
     assert _canon(out, ["c1", "c2", "rv"]) == _canon(oracle, ["c1", "c2", "rv"])
 
 
-def test_duplicate_build_keys_fall_back_to_host():
-    """Many-many joins decline (searchsorted yields one match); results
-    still correct through the host subplan."""
+def test_duplicate_build_keys_run_on_mesh():
+    """Many-many joins run ON the mesh now: paired searchsorted run-lengths
+    + bounded-width gather expand every duplicate match."""
     left = pa.table(
         {
             "dk": pa.array([1, 2, 2, 3], type=pa.int64()),
@@ -165,18 +165,81 @@ def test_duplicate_build_keys_fall_back_to_host():
     )
     right = pa.table(
         {
-            "fk": pa.array([2, 3, 4], type=pa.int64()),
-            "amount": pa.array([1.0, 2.0, 3.0]),
+            "fk": pa.array([2, 3, 4, 2], type=pa.int64()),
+            "amount": pa.array([1.0, 2.0, 3.0, 4.0]),
         }
     )
     spmd, cfg = _plan_join(left, right, ["dk"], ["fk"], "inner", nl=1, nr=2)
     assert spmd is not None
     tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
     out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
-    # declines join INLINE over the already-collected sides (no subplan
-    # re-execution, no shuffle materialization)
-    assert spmd.last_path == "host-inline"
+    assert spmd.last_path == "mesh", "duplicate build keys must not decline"
     oracle = _host_oracle(left, right, ["dk"], ["fk"], "inner")
+    assert out.num_rows == oracle.num_rows == 5  # 2x2 + 1 match expansion
+    assert _canon(out, ["dk", "amount"]) == _canon(oracle, ["dk", "amount"])
+
+
+def test_duplicate_build_keys_left_join_on_mesh():
+    """LEFT join with duplicate build keys: the matched-left bitmap must be
+    duplicate-safe (every copy of a matched key counts as matched; unmatched
+    build rows null-pad exactly once)."""
+    rng = np.random.default_rng(7)
+    n = 400
+    left = pa.table(
+        {
+            "dk": pa.array(rng.integers(0, 60, n), type=pa.int64()),
+            "name": pa.array([f"d{i}" for i in range(n)]),
+        }
+    )
+    right = pa.table(
+        {
+            "fk": pa.array(rng.integers(0, 40, 900), type=pa.int64()),
+            "amount": pa.array(rng.uniform(-5, 5, 900)),
+        }
+    )
+    spmd, cfg = _plan_join(left, right, ["dk"], ["fk"], "left")
+    assert spmd is not None
+    tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+    out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
+    assert spmd.last_path == "mesh"
+    oracle = _host_oracle(left, right, ["dk"], ["fk"], "left")
+    assert out.num_rows == oracle.num_rows
+    assert _canon(out, ["dk", "name", "amount"]) == _canon(
+        oracle, ["dk", "name", "amount"]
+    )
+
+
+def test_multiplicity_past_top_tier_steps_aside():
+    """A monster build key beyond JOIN_MULTIPLICITY_TIERS[-1] declines with
+    a recorded reason and joins INLINE over the already-collected sides (no
+    subplan re-execution, no shuffle materialization) — never wrong rows."""
+    from ballista_tpu.ops.kernels import JOIN_MULTIPLICITY_TIERS
+    from ballista_tpu.ops.runtime import join_path_stats
+
+    mult = JOIN_MULTIPLICITY_TIERS[-1] + 10
+    left = pa.table(
+        {
+            "dk": pa.array([7] * mult + [1, 2], type=pa.int64()),
+            "name": pa.array([f"d{i}" for i in range(mult + 2)]),
+        }
+    )
+    right = pa.table(
+        {
+            "fk": pa.array([7, 1, 9], type=pa.int64()),
+            "amount": pa.array([1.0, 2.0, 3.0]),
+        }
+    )
+    spmd, cfg = _plan_join(left, right, ["dk"], ["fk"], "inner", nl=1, nr=2)
+    assert spmd is not None
+    join_path_stats(reset=True)
+    tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+    out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
+    assert spmd.last_path == "host-inline"
+    stats = join_path_stats(reset=True)
+    assert stats["paths"].get("step_aside") == 1
+    assert any("multiplicity" in r for r in stats["reasons"])
+    oracle = _host_oracle(left, right, ["dk"], ["fk"], "inner")
+    assert out.num_rows == oracle.num_rows == mult + 1
     assert _canon(out, ["dk", "amount"]) == _canon(oracle, ["dk", "amount"])
 
 

@@ -99,6 +99,21 @@ def test_decline_rule_flags_all_three_shapes():
     assert any("return None" in m for m in findings)
 
 
+def test_overflow_decline_fixture_pair():
+    """The M:N join tier-overflow decline site (ISSUE 4): a reasonless
+    overflow raise / silent None is flagged; the canonical
+    join_multiplicity_tier + step_aside + record_join_path shape is clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "decline_overflow_bad.py"))
+        if f.rule == "decline-discipline"
+    ]
+    assert any("without a reason" in m for m in findings)
+    assert any("return None" in m for m in findings)
+    good = analyze_file(str(FIXTURES / "decline_overflow_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_guarded_rule_checks_holds_lock_callers():
     findings = [
         f.message for f in analyze_file(str(FIXTURES / "guarded_bad.py"))
