@@ -46,7 +46,12 @@ class BallistaContext(ExecutionContext):
         super().__init__(BallistaConfig(settings))
         self.host = host
         self.port = port
-        self._client = SchedulerGrpcClient(host, port)
+        self._client = SchedulerGrpcClient(
+            host,
+            port,
+            retries=self.config.rpc_retries(),
+            backoff_s=self.config.rpc_backoff_s(),
+        )
 
     @classmethod
     def remote(cls, host: str, port: int, settings=None) -> "BallistaContext":
@@ -104,7 +109,12 @@ class BallistaContext(ExecutionContext):
     def _fetch_partition(self, loc: pb.PartitionLocation) -> pa.Table:
         from ballista_tpu.client.flight import BallistaClient
 
-        client = BallistaClient(loc.executor_meta.host, loc.executor_meta.port)
+        client = BallistaClient(
+            loc.executor_meta.host,
+            loc.executor_meta.port,
+            retries=self.config.rpc_retries(),
+            backoff_s=self.config.rpc_backoff_s(),
+        )
         try:
             # the final stage writes piece 0 per input partition
             return client.fetch_partition(os.path.join(loc.path, "0.arrow"))

@@ -77,6 +77,25 @@ BALLISTA_TPU_INGEST_DEPTH = "ballista.tpu.ingest_depth"
 # executes any deserialized plan (rust/executor/src/flight_service.rs:90-192);
 # a rewrite should not let an unauthenticated peer scan arbitrary host files.
 BALLISTA_DATA_ROOTS = "ballista.executor.data_roots"
+# -- failure recovery (scheduler/state.py, executor/execution_loop.py) ------
+# how many times a failed task is requeued before the job fails with the
+# full attempt history (the reference fails the job on the FIRST task
+# failure, SURVEY §5 "no retry"). Counts ALL requeue causes: task errors,
+# executor death, lost shuffle outputs, fetch failures.
+BALLISTA_MAX_TASK_RETRIES = "ballista.shuffle.max_task_retries"
+# transient-RPC resilience: attempts beyond the first for UNAVAILABLE /
+# connect failures (execution errors surface immediately), and the jittered
+# exponential backoff base between them
+BALLISTA_RPC_RETRIES = "ballista.rpc.retries"
+BALLISTA_RPC_BACKOFF_MS = "ballista.rpc.backoff_ms"
+# -- deterministic fault injection (utils/chaos.py) -------------------------
+# rate > 0 arms the registered injection sites; each (site, key) pair draws
+# a DETERMINISTIC verdict from sha256(seed, site, key), so a chaos run is
+# reproducible and recovery must deliver results bit-identical to the
+# fault-free run. sites: comma-separated subset of chaos.SITES ("" = all).
+BALLISTA_CHAOS_SEED = "ballista.chaos.seed"
+BALLISTA_CHAOS_RATE = "ballista.chaos.rate"
+BALLISTA_CHAOS_SITES = "ballista.chaos.sites"
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -114,6 +133,12 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_INGEST_WORKERS: "2",
     BALLISTA_TPU_INGEST_DEPTH: "2",
     BALLISTA_DATA_ROOTS: "",
+    BALLISTA_MAX_TASK_RETRIES: "3",
+    BALLISTA_RPC_RETRIES: "3",
+    BALLISTA_RPC_BACKOFF_MS: "50",
+    BALLISTA_CHAOS_SEED: "0",
+    BALLISTA_CHAOS_RATE: "0",
+    BALLISTA_CHAOS_SITES: "",
 }
 
 
@@ -213,6 +238,36 @@ class BallistaConfig(Mapping[str, str]):
     def tpu_ingest_depth(self) -> int:
         """Bound on prefetched items in flight (host-RSS cap)."""
         return max(1, int(self._settings[BALLISTA_TPU_INGEST_DEPTH]))
+
+    def max_task_retries(self) -> int:
+        """Requeues allowed per task before the job fails (0 = reference
+        behavior: first failure kills the job)."""
+        return max(0, int(self._settings[BALLISTA_MAX_TASK_RETRIES]))
+
+    def rpc_retries(self) -> int:
+        """Transient-RPC retry attempts beyond the first call."""
+        return max(0, int(self._settings[BALLISTA_RPC_RETRIES]))
+
+    def rpc_backoff_s(self) -> float:
+        """Jittered-exponential backoff base, in seconds."""
+        return max(0.0, float(self._settings[BALLISTA_RPC_BACKOFF_MS])) / 1000.0
+
+    def chaos_seed(self) -> int:
+        return int(self._settings[BALLISTA_CHAOS_SEED])
+
+    def chaos_rate(self) -> float:
+        r = float(self._settings[BALLISTA_CHAOS_RATE])
+        if not 0.0 <= r <= 1.0:
+            raise ValueError(f"ballista.chaos.rate must be in [0, 1], got {r}")
+        return r
+
+    def chaos_sites(self):
+        """Enabled injection sites; [] = all registered sites."""
+        return [
+            s.strip()
+            for s in self._settings[BALLISTA_CHAOS_SITES].split(",")
+            if s.strip()
+        ]
 
     def data_roots(self):
         """Directory allowlist for wire-plan scan paths; [] = unrestricted."""

@@ -54,19 +54,37 @@ def _ipc_options(codec: Optional[str]) -> Optional[pa.ipc.IpcWriteOptions]:
     return pa.ipc.IpcWriteOptions(compression=codec)
 
 
+def _piece_tmp_path(path: str) -> str:
+    """Writer-unique temp name beside the final piece. Pieces are published
+    by os.replace so a reader (or a concurrent duplicate execution — e.g. a
+    client retrying an execute_partition whose first run is still going)
+    never sees a half-written or interleaved file: last complete writer
+    wins atomically."""
+    import threading
+
+    return f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+
+
 def write_stream_to_disk(
     batches: Iterator[pa.RecordBatch], schema: pa.Schema, path: str,
     codec: Optional[str] = None,
 ) -> PartitionStats:
-    """Arrow IPC file writer with stats (ref utils.rs write_stream_to_disk)."""
+    """Arrow IPC file writer with stats (ref utils.rs write_stream_to_disk).
+    Writes to a temp name and atomically publishes on success."""
     stats = PartitionStats()
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with pa.ipc.new_file(path, schema, options=_ipc_options(codec)) as w:
-        for b in batches:
-            w.write_batch(b)
-            stats.num_rows += b.num_rows
-            stats.num_batches += 1
-            stats.num_bytes += b.nbytes
+    tmp = _piece_tmp_path(path)
+    try:
+        with pa.ipc.new_file(tmp, schema, options=_ipc_options(codec)) as w:
+            for b in batches:
+                w.write_batch(b)
+                stats.num_rows += b.num_rows
+                stats.num_batches += 1
+                stats.num_bytes += b.nbytes
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return stats
 
 
@@ -136,9 +154,12 @@ class ShuffleWriterExec(ExecutionPlan):
         writers = []
         os.makedirs(base, exist_ok=True)
         opts = _ipc_options(codec)
-        for m in range(n_out):
-            sink = pa.OSFile(os.path.join(base, f"{m}.arrow"), "wb")
+        finals = [os.path.join(base, f"{m}.arrow") for m in range(n_out)]
+        tmps = [_piece_tmp_path(p) for p in finals]
+        for tmp in tmps:
+            sink = pa.OSFile(tmp, "wb")
             writers.append((sink, pa.ipc.new_file(sink, schema, options=opts)))
+        ok = False
         try:
             import numpy as np
 
@@ -159,10 +180,21 @@ class ShuffleWriterExec(ExecutionPlan):
                         total.num_rows += piece.num_rows
                         total.num_bytes += piece.nbytes
                 total.num_batches += 1
+            ok = True
         finally:
             for sink, w in writers:
                 w.close()
                 sink.close()
+            if ok:
+                # publish atomically only after EVERY piece closed clean —
+                # readers (and concurrent duplicate executions) never see a
+                # partial or interleaved piece
+                for tmp, final in zip(tmps, finals):
+                    os.replace(tmp, final)
+            else:
+                for tmp in tmps:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
         return total
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
@@ -172,7 +204,10 @@ class ShuffleWriterExec(ExecutionPlan):
             ctx.work_dir, self.job_id, str(self.stage_id), str(partition)
         )
         for name in sorted(os.listdir(base)):
-            yield from read_ipc_file(os.path.join(base, name))
+            # only PUBLISHED pieces: a concurrent duplicate execution's
+            # in-flight *.tmp-* files are not readable IPC yet
+            if name.endswith(".arrow"):
+                yield from read_ipc_file(os.path.join(base, name))
 
     def fmt(self) -> str:
         return (
@@ -182,16 +217,32 @@ class ShuffleWriterExec(ExecutionPlan):
 
 
 class ShuffleLocation:
-    """Where one completed map task's output lives."""
+    """Where one completed map task's output lives. stage_id/map_partition
+    name the producing map task (lineage): a reduce task that fails to fetch
+    from here reports them in its fetch_failed status so the scheduler can
+    recompute exactly that map partition."""
 
-    def __init__(self, executor_id: str, host: str, port: int, path: str) -> None:
+    def __init__(
+        self,
+        executor_id: str,
+        host: str,
+        port: int,
+        path: str,
+        stage_id: int = 0,
+        map_partition: int = 0,
+    ) -> None:
         self.executor_id = executor_id
         self.host = host
         self.port = port
         self.path = path  # base dir containing {m}.arrow pieces
+        self.stage_id = stage_id
+        self.map_partition = map_partition
 
     def __repr__(self) -> str:
-        return f"ShuffleLocation({self.executor_id}@{self.host}:{self.port}, {self.path})"
+        return (
+            f"ShuffleLocation({self.executor_id}@{self.host}:{self.port}, "
+            f"{self.path}, map={self.stage_id}/{self.map_partition})"
+        )
 
 
 class ShuffleReaderExec(ExecutionPlan):
@@ -253,12 +304,57 @@ class ShuffleReaderExec(ExecutionPlan):
     def _read_piece(
         self, loc: ShuffleLocation, piece_idx: int, ctx: TaskContext
     ) -> Iterator[pa.RecordBatch]:
+        from ballista_tpu.errors import RpcError, ShuffleFetchError
+        from ballista_tpu.utils.chaos import ChaosInjected, chaos_from_config
+
         piece = os.path.join(loc.path, f"{piece_idx}.arrow")
+        chaos = chaos_from_config(ctx.config)
+        if chaos is not None:
+            try:
+                # keyed on PLAN coordinates (map stage/partition + piece) +
+                # the consuming attempt — never on job id or paths, which
+                # are random per run: the same seed injects the same faults
+                # every run, and the retry after a lineage recompute draws a
+                # fresh verdict instead of failing forever
+                chaos.maybe_fail(
+                    "flight.fetch",
+                    f"{loc.stage_id}/{loc.map_partition}/piece{piece_idx}"
+                    f"@a{ctx.attempt}",
+                )
+            except ChaosInjected as e:
+                # surface exactly like a real lost fetch so the injected
+                # fault drives the fetch_failed -> lineage-recompute path
+                raise ShuffleFetchError(
+                    f"shuffle fetch of {piece} from {loc.executor_id}: {e}",
+                    executor_id=loc.executor_id,
+                    host=loc.host,
+                    port=loc.port,
+                    path=loc.path,
+                    stage_id=loc.stage_id,
+                    map_partition=loc.map_partition,
+                ) from e
         resolved = self._local_read_path(piece, ctx)
         if resolved is not None and os.path.exists(resolved):
             yield from read_ipc_file(resolved)
         elif ctx.shuffle_fetcher is not None:
-            yield from ctx.shuffle_fetcher(loc, piece_idx)
+            try:
+                yield from ctx.shuffle_fetcher(loc, piece_idx)
+            except ShuffleFetchError:
+                raise
+            except RpcError as e:
+                # attach the lineage of the lost location: the executor's
+                # task runner turns this into a fetch_failed status and the
+                # scheduler recomputes ONLY loc's map partition
+                raise ShuffleFetchError(
+                    f"shuffle fetch of {piece} from "
+                    f"{loc.executor_id}@{loc.host}:{loc.port} failed: {e}",
+                    executor_id=loc.executor_id,
+                    host=loc.host,
+                    port=loc.port,
+                    path=loc.path,
+                    stage_id=loc.stage_id,
+                    map_partition=loc.map_partition,
+                ) from e
         else:
             raise ExecutionError(
                 f"shuffle piece not found locally and no fetcher: {piece}"

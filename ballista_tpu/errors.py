@@ -43,5 +43,32 @@ class RpcError(BallistaError):
     """Control-plane (gRPC) failure (reference Tonic/Grpc variants)."""
 
 
+class ShuffleFetchError(RpcError):
+    """A shuffle fetch from a peer executor failed mid-task. Carries the
+    lost location (owning executor + map stage/partition + path) so the
+    executor can report a `fetch_failed` status and the scheduler can
+    recompute just that map partition (lineage-based shuffle recovery)
+    instead of failing the job."""
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        executor_id: str = "",
+        host: str = "",
+        port: int = 0,
+        path: str = "",
+        stage_id: int = 0,
+        map_partition: int = 0,
+    ) -> None:
+        super().__init__(message)
+        self.executor_id = executor_id
+        self.host = host
+        self.port = port
+        self.path = path
+        self.stage_id = stage_id
+        self.map_partition = map_partition
+
+
 class ExecutionError(BallistaError):
     """Runtime failure while executing a physical plan."""

@@ -43,7 +43,10 @@ class PollLoop:
         work_dir: str,
         config: Optional[BallistaConfig] = None,
         concurrent_tasks: int = 4,  # ref executor_config_spec.toml default
+        on_death=None,
     ) -> None:
+        from ballista_tpu.utils.chaos import chaos_from_config
+
         self.scheduler = scheduler
         self.metadata = metadata
         self.work_dir = work_dir
@@ -61,6 +64,19 @@ class PollLoop:
         # (SURVEY §5 "Nothing garbage-collects work dirs")
         self.shuffle_ttl_seconds = 3600.0
         self._last_gc = time.time()  # guarded-by: self._mu
+        # deterministic fault injection (utils/chaos.py): "executor.death"
+        # hard-stops this loop mid-run — on_death (wired by the runtime to
+        # also shut the Flight data plane) makes the death total, so the
+        # executor's completed shuffle outputs really become unreachable
+        self._chaos = chaos_from_config(self.config)
+        self._poll_n = 0  # poll-thread only: chaos key rotation
+        self.on_death = on_death
+        # tasks currently executing here, echoed in every poll so the
+        # scheduler can reconcile assignments whose response never reached
+        # us (lost-in-transit PollWork replies would otherwise orphan the
+        # task in Running forever)
+        self._inflight_mu = threading.Lock()
+        self._inflight: dict = {}  # (job, stage, part) -> PartitionId; guarded-by: self._inflight_mu
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -78,6 +94,25 @@ class PollLoop:
 
     def run(self) -> None:
         while not self._stop.is_set():
+            self._poll_n += 1
+            if self._chaos is not None and self._chaos.should_inject(
+                "executor.death", f"{self.metadata.id}/poll{self._poll_n}"
+            ):
+                from ballista_tpu.ops.runtime import record_recovery
+
+                record_recovery("chaos_injected")
+                record_recovery("chaos_executor_death")
+                log.warning(
+                    "chaos[executor.death]: executor %s dying at poll %d",
+                    self.metadata.id, self._poll_n,
+                )
+                self._stop.set()
+                if self.on_death is not None:
+                    try:
+                        self.on_death()
+                    except Exception as e:
+                        log.warning("on_death hook failed: %s", e)
+                return
             try:
                 self.poll_once()
             except Exception as e:
@@ -124,30 +159,72 @@ class PollLoop:
                 return out
 
     def poll_once(self) -> bool:
-        """One PollWork round; returns True if a task was received."""
-        can_accept = self._available.acquire(blocking=False)
-        if can_accept:
-            self._available.release()
-        params = pb.PollWorkParams(
-            metadata=self.metadata, can_accept_task=can_accept
-        )
-        for st in self._drain_statuses():
-            params.task_status.add().CopyFrom(st)
-        result = self.scheduler.poll_work(params)
+        """One PollWork round; returns True if a task was received.
+
+        The slot probe acquires ONCE, non-blocking, and hands the held slot
+        to _run_task when a task arrives. (The previous probe-release-then-
+        blocking-reacquire was a TOCTOU: concurrent completions between the
+        probe and the reacquire could leave the poll thread BLOCKED on the
+        semaphore, stopping heartbeats until a slot freed — long enough and
+        a healthy executor got its lease lapsed and its tasks reset.)"""
+        slot_held = self._available.acquire(blocking=False)
+        # snapshot in-flight BEFORE draining statuses: a task finishing in
+        # between is then reported as running (its status follows next
+        # poll) rather than as neither — "neither" would read as an
+        # orphaned assignment and trigger a spurious requeue
+        with self._inflight_mu:
+            inflight = list(self._inflight.values())
+        statuses = self._drain_statuses()
+        try:
+            params = pb.PollWorkParams(
+                metadata=self.metadata, can_accept_task=slot_held
+            )
+            for pid in inflight:
+                params.running_tasks.add().CopyFrom(pid)
+            for st in statuses:
+                params.task_status.add().CopyFrom(st)
+            result = self.scheduler.poll_work(params)
+        except Exception:
+            if slot_held:
+                self._available.release()
+            # the poll carried finished-task statuses; losing them would
+            # wedge their jobs (the scheduler would wait forever) — requeue
+            # for the next poll, which retries the delivery
+            for st in statuses:
+                self._finished.put(st)
+            raise
         if result.HasField("task"):
-            self._available.acquire()
+            pid = result.task.task_id
+            with self._inflight_mu:
+                self._inflight[(pid.job_id, pid.stage_id, pid.partition_id)] = pid
+            # slot ownership transfers to the task thread (released in
+            # _run_task's finally). A task arriving WITHOUT a held slot
+            # (scheduler ignored can_accept_task=False) must not be
+            # dropped — the task thread blocks for a slot itself, where
+            # waiting cannot stall heartbeats
             threading.Thread(
-                target=self._run_task, args=(result.task,), daemon=True
+                target=self._run_task,
+                args=(result.task, slot_held),
+                daemon=True,
             ).start()
             return True
+        if slot_held:
+            self._available.release()
         return False
 
-    def _run_task(self, task: pb.TaskDefinition) -> None:
+    def _run_task(self, task: pb.TaskDefinition, slot_held: bool = True) -> None:
+        from ballista_tpu.errors import ShuffleFetchError
         from ballista_tpu.serde.physical import phys_plan_from_proto
+        from ballista_tpu.utils.chaos import chaos_from_config
 
+        if not slot_held:
+            self._available.acquire()
         pid = task.task_id
         status = pb.TaskStatus()
         status.partition_id.CopyFrom(pid)
+        # echo the attempt in every reported status: the scheduler uses it
+        # to drop stale reports from attempts it already reset
+        status.attempt = task.attempt
         try:
             # allowlist comes from the EXECUTOR's own config; the per-job
             # settings merged below are client-controlled and must not
@@ -171,11 +248,29 @@ class PollLoop:
                 cfg = BallistaConfig(
                     {**cfg.to_dict(), **{kv.key: kv.value for kv in task.settings}}
                 )
+            # chaos from the MERGED config: per-job settings can arm the
+            # "task.execute" site for just their job. Keyed on the attempt
+            # so a retried attempt draws a fresh deterministic verdict.
+            chaos = chaos_from_config(cfg)
+            if chaos is not None:
+                # keyed on plan coordinates + attempt, NOT the (random) job
+                # id: the same seed faults the same tasks every run
+                chaos.maybe_fail(
+                    "task.execute",
+                    f"{pid.stage_id}/{pid.partition_id}@a{task.attempt}",
+                )
+            import functools
+
             ctx = TaskContext(
                 config=cfg,
                 work_dir=self.work_dir,
                 job_id=pid.job_id,
-                shuffle_fetcher=flight_shuffle_fetcher,
+                # bind the merged config so fetch retries honor
+                # ballista.rpc.* (incl. per-job overrides)
+                shuffle_fetcher=functools.partial(
+                    flight_shuffle_fetcher, config=cfg
+                ),
+                attempt=task.attempt,
             )
             stats = plan.execute_shuffle_write(pid.partition_id, ctx)
             base = os.path.join(
@@ -190,9 +285,32 @@ class PollLoop:
                 "task %s/%s/%s completed (%d rows)",
                 pid.job_id, pid.stage_id, pid.partition_id, stats.num_rows,
             )
+        except ShuffleFetchError as e:
+            # a shuffle fetch died, not this task's own work: report
+            # fetch_failed NAMING THE LOST LOCATION so the scheduler
+            # recomputes just that map partition (lineage recovery)
+            log.warning(
+                "task %s/%s/%s fetch failed (lost %s:%s): %s",
+                pid.job_id, pid.stage_id, pid.partition_id,
+                e.executor_id, e.path, e,
+            )
+            status.fetch_failed.error = str(e)
+            status.fetch_failed.executor_id = self.metadata.id
+            status.fetch_failed.map_stage_id = e.stage_id
+            status.fetch_failed.map_partition_id = e.map_partition
+            status.fetch_failed.map_executor_id = e.executor_id
+            status.fetch_failed.path = e.path
         except Exception as e:
             log.error("task %s failed: %s", pid, traceback.format_exc())
             status.failed.error = f"{type(e).__name__}: {e}"
+            status.failed.executor_id = self.metadata.id
         finally:
             self._available.release()
+        # enqueue the status BEFORE dropping from in-flight: a poll in the
+        # gap then reports the task as still running (harmless) instead of
+        # as vanished (which would look like an orphaned assignment)
         self._finished.put(status)
+        with self._inflight_mu:
+            self._inflight.pop(
+                (pid.job_id, pid.stage_id, pid.partition_id), None
+            )

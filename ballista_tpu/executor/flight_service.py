@@ -16,7 +16,7 @@ from __future__ import annotations
 import logging
 import os
 import re
-from typing import Iterator
+from typing import Iterator, Optional
 
 import pyarrow as pa
 import pyarrow.flight as flight
@@ -94,9 +94,12 @@ class BallistaFlightService(flight.FlightServerBase):
         check_proto_scan_roots(req.plan, roots)
         plan = phys_plan_from_proto(req.plan)
         check_scan_roots(plan, roots)
+        import functools
+
         cfg = BallistaConfig({**self.config.to_dict(), **{kv.key: kv.value for kv in settings}})
         ctx = TaskContext(config=cfg, work_dir=self.work_dir, job_id=req.job_id,
-                          shuffle_fetcher=flight_shuffle_fetcher)
+                          shuffle_fetcher=functools.partial(
+                              flight_shuffle_fetcher, config=cfg))
         rows = []
         for p in req.partition_ids:
             if isinstance(plan, ShuffleWriterExec):
@@ -120,14 +123,22 @@ class BallistaFlightService(flight.FlightServerBase):
         return flight.RecordBatchStream(table)
 
 
-def flight_shuffle_fetcher(loc: ShuffleLocation, partition: int) -> Iterator[pa.RecordBatch]:
+def flight_shuffle_fetcher(
+    loc: ShuffleLocation, partition: int, config: Optional[BallistaConfig] = None
+) -> Iterator[pa.RecordBatch]:
     """ShuffleReaderExec's remote path: Flight do_get(FetchPartition) against
-    the executor owning the piece (ref client.rs:123-169)."""
+    the executor owning the piece (ref client.rs:123-169). Bind `config`
+    (functools.partial at TaskContext construction) so the data plane honors
+    ballista.rpc.retries/backoff_ms like the control plane does."""
     from ballista_tpu.client.flight import BallistaClient
 
     action = pb.Action()
     action.fetch_partition.path = os.path.join(loc.path, f"{partition}.arrow")
-    client = BallistaClient(loc.host, loc.port)
+    cfg = config or BallistaConfig()
+    client = BallistaClient(
+        loc.host, loc.port,
+        retries=cfg.rpc_retries(), backoff_s=cfg.rpc_backoff_s(),
+    )
     try:
         yield from client.stream_action(action)
     finally:

@@ -58,7 +58,15 @@ class BallistaExecutor:
             f"grpc://0.0.0.0:{self.port}", self.work_dir, self.config
         )
         self._flight_thread = threading.Thread(target=self.flight.serve, daemon=True)
-        self.scheduler_client = SchedulerGrpcClient(scheduler_host, scheduler_port)
+        from ballista_tpu.utils.chaos import chaos_from_config
+
+        self.scheduler_client = SchedulerGrpcClient(
+            scheduler_host,
+            scheduler_port,
+            retries=self.config.rpc_retries(),
+            backoff_s=self.config.rpc_backoff_s(),
+            chaos=chaos_from_config(self.config),
+        )
         meta = pb.ExecutorMetadata(id=self.id, host=self.host, port=self.port)
         self.poll_loop = PollLoop(
             self.scheduler_client,
@@ -66,6 +74,10 @@ class BallistaExecutor:
             self.work_dir,
             config=self.config,
             concurrent_tasks=concurrent_tasks,
+            # chaos executor.death must be a TOTAL death: heartbeats stop
+            # AND the data plane goes away, so completed shuffle outputs
+            # really become unreachable and lineage recovery is exercised
+            on_death=self.flight.shutdown,
         )
 
     def start(self) -> None:
@@ -94,12 +106,15 @@ class StandaloneCluster:
         self.port = _free_port()
         self.grpc_server = serve(self.scheduler_impl, "127.0.0.1", self.port)
         self.executors: List[BallistaExecutor] = []
-        for _ in range(n_executors):
+        for i in range(n_executors):
             ex = BallistaExecutor(
                 "127.0.0.1",
                 self.port,
                 config=self.config,
                 concurrent_tasks=concurrent_tasks,
+                # stable ids: chaos keys (executor.death) and test
+                # assertions address executors deterministically
+                executor_id=f"local-{i}",
             )
             ex.start()
             self.executors.append(ex)

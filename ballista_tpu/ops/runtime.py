@@ -739,3 +739,29 @@ def join_path_stats(reset: bool = False) -> Dict[str, Dict[str, int]]:
             _join_paths.clear()
             _join_reasons.clear()
     return out
+
+
+# accumulated failure-recovery events across scheduler/executor/client
+# (bench.py reports them per config beside readback/join_paths): every
+# retry, lineage recompute, stale-report drop, transient-RPC retry, and
+# chaos injection lands in exactly one named bucket, so a bench row under
+# `ballista.chaos.rate` > 0 shows both the injected faults AND the recovery
+# work they triggered. In-process accumulator like the readback totals —
+# the standalone cluster (scheduler + executors in one process) is where
+# chaos runs live; separate daemons each report their own share.
+_recovery_lock = threading.Lock()
+_recovery: Dict[str, int] = {}  # event -> count; guarded-by: _recovery_lock
+
+
+def record_recovery(event: str, n: int = 1) -> None:
+    with _recovery_lock:
+        _recovery[event] = _recovery.get(event, 0) + int(n)
+
+
+def recovery_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated recovery-event counters."""
+    with _recovery_lock:
+        out = dict(_recovery)
+        if reset:
+            _recovery.clear()
+    return out

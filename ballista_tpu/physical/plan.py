@@ -58,6 +58,7 @@ class TaskContext:
         shuffle_fetcher=None,
         work_dir: Optional[str] = None,
         job_id: str = "",
+        attempt: int = 0,
     ) -> None:
         self.config = config or BallistaConfig()
         # shuffle_fetcher: callable(PartitionLocation) -> Iterator[RecordBatch];
@@ -65,6 +66,9 @@ class TaskContext:
         self.shuffle_fetcher = shuffle_fetcher
         self.work_dir = work_dir
         self.job_id = job_id
+        # which attempt of the task this context serves: part of the chaos
+        # injection key so a retried attempt draws a fresh fault verdict
+        self.attempt = attempt
 
     @property
     def batch_size(self) -> int:

@@ -8,6 +8,9 @@ generated stubs (method paths /ballista.SchedulerGrpc/<Method>).
 
 from __future__ import annotations
 
+import random
+import threading
+import time
 from typing import Optional
 
 import grpc
@@ -55,13 +58,43 @@ def add_scheduler_service(server: grpc.Server, servicer) -> None:
     )
 
 
-class SchedulerGrpcClient:
-    """Client stub (plays the role of tonic's generated SchedulerGrpcClient)."""
+def backoff_delay(attempt: int, base: float, cap: float = 2.0) -> float:
+    """Jittered exponential backoff: base * 2^attempt scaled by a uniform
+    [0.5, 1.5) jitter so a fleet of retrying clients decorrelates, then
+    capped — the cap is a hard ceiling (an executor sleeping past it eats
+    into its heartbeat/lease budget). The jitter draws from the module rng —
+    it shapes TIMING only, never results, so it stays outside the
+    deterministic chaos machinery."""
+    if base <= 0.0:
+        return 0.0
+    return min(cap, base * (2.0 ** attempt) * random.uniform(0.5, 1.5))
 
-    def __init__(self, host: str, port: int, channel: Optional[grpc.Channel] = None) -> None:
+
+class SchedulerGrpcClient:
+    """Client stub (plays the role of tonic's generated SchedulerGrpcClient).
+
+    Transient failures (UNAVAILABLE / connect errors — a scheduler restart,
+    a network blip) are retried `retries` times with jittered exponential
+    backoff; execution errors surface immediately. An armed chaos injector
+    (utils/chaos.py "rpc.call" site) exercises exactly this loop."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        channel: Optional[grpc.Channel] = None,
+        retries: int = 3,
+        backoff_s: float = 0.05,
+        chaos=None,
+    ) -> None:
         self.channel = channel or grpc.insecure_channel(
             f"{host}:{port}", options=GRPC_MESSAGE_OPTIONS
         )
+        self.retries = max(0, retries)
+        self.backoff_s = backoff_s
+        self.chaos = chaos
+        self._chaos_mu = threading.Lock()
+        self._chaos_calls: dict = {}  # method -> call count; guarded-by: self._chaos_mu
         self._stubs = {}
         for name, (req_cls, resp_cls) in _METHODS.items():
             self._stubs[name] = self.channel.unary_unary(
@@ -70,14 +103,46 @@ class SchedulerGrpcClient:
                 response_deserializer=resp_cls.FromString,
             )
 
-    def _call(self, name: str, params):
-        from ballista_tpu.errors import RpcError
+    def _chaos_key(self, name: str) -> str:
+        # per-method call index: a RETRY of a failed call draws a fresh
+        # deterministic verdict instead of failing forever
+        with self._chaos_mu:
+            n = self._chaos_calls.get(name, 0) + 1
+            self._chaos_calls[name] = n
+        return f"{name}/{n}"
 
-        try:
-            return self._stubs[name](params)
-        except grpc.RpcError as e:
-            detail = e.details() if hasattr(e, "details") else str(e)
-            raise RpcError(f"{name} failed: {detail}") from e
+    def _call(self, name: str, params, also_transient=None):
+        """One RPC with the transient-retry loop. `also_transient` is an
+        optional predicate over the error detail string for responses a
+        specific method knows to be retryable (e.g. the GetFileMetadata
+        throttle hint) even though their status code says otherwise."""
+        from ballista_tpu.errors import RpcError
+        from ballista_tpu.ops.runtime import record_recovery
+        from ballista_tpu.utils.chaos import ChaosInjected
+
+        attempts = self.retries + 1
+        for i in range(attempts):
+            try:
+                if self.chaos is not None:
+                    self.chaos.maybe_fail("rpc.call", self._chaos_key(name))
+                return self._stubs[name](params)
+            except ChaosInjected as e:
+                transient, detail, err = True, str(e), e
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                detail = e.details() if hasattr(e, "details") else str(e)
+                # UNAVAILABLE covers both "server not up yet" (connect
+                # refused) and "went away mid-call"; anything else is the
+                # server actually answering — surface it immediately
+                transient = code == grpc.StatusCode.UNAVAILABLE or (
+                    also_transient is not None and also_transient(detail)
+                )
+                err = e
+            if not transient or i + 1 >= attempts:
+                raise RpcError(f"{name} failed: {detail}") from err
+            record_recovery("rpc_retry")
+            time.sleep(backoff_delay(i, self.backoff_s))
+        raise AssertionError("unreachable")  # loop always returns or raises
 
     def execute_query(self, params: pb.ExecuteQueryParams) -> pb.ExecuteQueryResult:
         return self._call("ExecuteQuery", params)
@@ -92,7 +157,17 @@ class SchedulerGrpcClient:
         return self._call("GetExecutorsMetadata", pb.GetExecutorMetadataParams())
 
     def get_file_metadata(self, params: pb.GetFileMetadataParams) -> pb.GetFileMetadataResult:
-        return self._call("GetFileMetadata", params)
+        """GetFileMetadata with throttle handling: the server sheds load
+        with a fail-fast 'too many concurrent metadata requests; retry'
+        error (scheduler/server.py caps its slots); honor the hint with the
+        shared backoff loop instead of surfacing it to the caller."""
+        return self._call(
+            "GetFileMetadata",
+            params,
+            also_transient=lambda detail: (
+                "too many concurrent metadata requests" in detail
+            ),
+        )
 
     def close(self) -> None:
         self.channel.close()

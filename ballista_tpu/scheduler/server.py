@@ -52,8 +52,8 @@ class SchedulerServer:
         config: Optional[BallistaConfig] = None,
         synchronous_planning: bool = False,
     ) -> None:
-        self.state = SchedulerState(kv or MemoryBackend(), namespace)
         self.config = config or BallistaConfig()
+        self.state = SchedulerState(kv or MemoryBackend(), namespace, config=self.config)
         # catalog for SQL queries arriving as text (CREATE EXTERNAL TABLE
         # statements executed through the scheduler register here)
         self.catalog = ExecutionContext(self.config)
@@ -175,8 +175,20 @@ class SchedulerServer:
                     log.warning("re-scheduled %d tasks from dead executors", n)
             jobs = set()
             for ts in request.task_status:
-                self.state.save_task_status(ts)
-                jobs.add(ts.partition_id.job_id)
+                # stale reports from already-reset attempts are dropped;
+                # accepted ones keep the KV-side attempt history
+                if self.state.accept_task_status(ts):
+                    jobs.add(ts.partition_id.job_id)
+            # after statuses (a completed report must clear its assignment
+            # first): requeue assignments this executor never received
+            n = self.state.reconcile_running_tasks(
+                request.metadata.id, request.running_tasks
+            )
+            if n:
+                log.warning(
+                    "requeued %d orphaned assignment(s) for executor %s",
+                    n, request.metadata.id,
+                )
             result = pb.PollWorkResult()
             if request.can_accept_task:
                 assigned = self.state.assign_next_schedulable_task(request.metadata.id)
@@ -185,6 +197,7 @@ class SchedulerServer:
                     from ballista_tpu.serde.physical import phys_plan_to_proto
 
                     result.task.task_id.CopyFrom(status.partition_id)
+                    result.task.attempt = status.attempt
                     result.task.plan.CopyFrom(phys_plan_to_proto(plan))
                     for k, v in self.state.get_job_settings(
                         status.partition_id.job_id

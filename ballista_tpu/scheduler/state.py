@@ -13,9 +13,11 @@ backend resumes mid-job. Key layout (ref state/mod.rs:387-434):
 
 from __future__ import annotations
 
+import logging
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
+from ballista_tpu.config import BALLISTA_MAX_TASK_RETRIES, BallistaConfig
 from ballista_tpu.distributed.planner import (
     find_unresolved_shuffles,
     remove_unresolved_shuffles,
@@ -25,7 +27,48 @@ from ballista_tpu.proto import ballista_pb2 as pb
 from ballista_tpu.scheduler.kv import KvBackend
 from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
 
+log = logging.getLogger("ballista.scheduler")
+
 EXECUTOR_LEASE_SECS = 60.0  # ref state/mod.rs:42
+
+# how long after assignment an executor's polls may omit a task from its
+# running_tasks echo before the scheduler treats the assignment as lost in
+# transit (PollWork response never arrived) and requeues it. Must exceed a
+# couple of executor poll intervals (0.25s) plus scheduling slack.
+ORPHANED_ASSIGNMENT_GRACE_SECS = 3.0
+
+
+def _record_recovery(event: str, n: int = 1) -> None:
+    # lazy: scheduler state must stay importable before the ops runtime
+    from ballista_tpu.ops.runtime import record_recovery
+
+    record_recovery(event, n)
+
+
+def _attempts_error(t: pb.TaskStatus) -> str:
+    """Human-readable failure naming EVERY attempt of the task — the error
+    a job fails with once retries are exhausted."""
+    lines = [
+        f"attempt {h.attempt} on {h.executor_id or '?'}: {h.error}"
+        for h in t.history
+    ]
+    w = t.WhichOneof("status")
+    if w == "failed":
+        lines.append(
+            f"attempt {t.attempt} on {t.failed.executor_id or '?'}: {t.failed.error}"
+        )
+    elif w == "fetch_failed":
+        ff = t.fetch_failed
+        lines.append(
+            f"attempt {t.attempt} on {ff.executor_id or '?'}: fetch of lost "
+            f"shuffle output {ff.map_executor_id}:{ff.path} "
+            f"(map {ff.map_stage_id}/{ff.map_partition_id}) failed: {ff.error}"
+        )
+    pid = t.partition_id
+    return (
+        f"task {pid.job_id}/{pid.stage_id}/{pid.partition_id} failed after "
+        f"{len(lines)} attempt(s): " + "; ".join(lines)
+    )
 
 
 class _TaskIndex:
@@ -88,11 +131,33 @@ TASK_INDEX_RESEED_SECS = 5.0
 
 
 class SchedulerState:
-    def __init__(self, kv: KvBackend, namespace: str = "default") -> None:
+    def __init__(
+        self,
+        kv: KvBackend,
+        namespace: str = "default",
+        config: Optional[BallistaConfig] = None,
+    ) -> None:
         self.kv = kv
         self.namespace = namespace
+        self.config = config or BallistaConfig()
         self._task_index: Optional[_TaskIndex] = None
         self._task_index_seeded_at = 0.0
+        # deterministic fault injection for the KV write seam (utils/chaos.py)
+        from ballista_tpu.utils.chaos import chaos_from_config
+
+        self._chaos = chaos_from_config(self.config)
+        self._chaos_puts = 0  # kv.put key rotation; under the kv lock
+        # in-memory assignment ledger: (job, stage, part) -> (executor,
+        # attempt, monotonic time). PollWork is retried on UNAVAILABLE and
+        # is NOT idempotent: if the response carrying an assignment is lost,
+        # the task sits Running on a live-lease executor that never heard of
+        # it. Executors echo their in-flight tasks each poll;
+        # reconcile_running_tasks requeues ledger entries the owner stopped
+        # vouching for. Single-scheduler in-memory state (a restarted
+        # scheduler re-orphans nothing — its entries are gone — so those
+        # tasks wait for the executor lease machinery instead). All access
+        # happens under the scheduler's global KV lock held by PollWork.
+        self._assigned: Dict[Tuple[str, int, int], Tuple[str, int, float]] = {}
 
     def _key(self, *parts: str) -> str:
         return "/".join(("/ballista", self.namespace) + parts)
@@ -167,12 +232,48 @@ class SchedulerState:
     # -- tasks ------------------------------------------------------------------
     def save_task_status(self, status: pb.TaskStatus) -> None:
         pid = status.partition_id
-        self.kv.put(
-            self._key("tasks", pid.job_id, str(pid.stage_id), str(pid.partition_id)),
-            status.SerializeToString(),
-        )
+        key = self._key("tasks", pid.job_id, str(pid.stage_id), str(pid.partition_id))
+        self.kv.put(key, status.SerializeToString())
         if self._task_index is not None:
             self._task_index.observe(status)
+
+    def accept_task_status(self, status: pb.TaskStatus) -> bool:
+        """Gate for executor-reported statuses: drop stale reports from
+        attempts the scheduler already reset (a requeued task's old executor
+        completing late must not clobber the retry's state), and carry the
+        KV-side attempt history forward over the report (executors don't
+        know it). Returns True when the status was applied."""
+        if self._chaos is not None:
+            # the kv.put site lives HERE (the executor-report path, not the
+            # planning writes): a faulted write raises out of PollWork, the
+            # executor requeues the report, and the next poll retries the
+            # delivery. Keyed on a write counter because a same-key verdict
+            # would fail that redelivery forever; the seeded verdict
+            # SEQUENCE (which k-th report write faults) stays reproducible.
+            self._chaos_puts += 1
+            self._chaos.maybe_fail("kv.put", f"put{self._chaos_puts}")
+        pid = status.partition_id
+        current = self.get_task_status(pid.job_id, pid.stage_id, pid.partition_id)
+        if current is not None and status.attempt < current.attempt:
+            _record_recovery("stale_status_dropped")
+            log.info(
+                "dropping stale status for %s/%s/%s (attempt %d < %d)",
+                pid.job_id, pid.stage_id, pid.partition_id,
+                status.attempt, current.attempt,
+            )
+            return False
+        merged = pb.TaskStatus()
+        merged.CopyFrom(status)
+        if current is not None and current.history:
+            merged.ClearField("history")
+            merged.history.MergeFrom(current.history)
+        self.save_task_status(merged)
+        if merged.WhichOneof("status") in ("completed", "failed", "fetch_failed"):
+            # the assignment resolved; stop watching for orphaning
+            self._assigned.pop(
+                (pid.job_id, pid.stage_id, pid.partition_id), None
+            )
+        return True
 
     def _ensure_task_index(self) -> _TaskIndex:
         """Seed the per-stage task index from one full scan, then keep it
@@ -227,27 +328,109 @@ class SchedulerState:
         return out
 
     # -- failure recovery ---------------------------------------------------
+    def retry_limit(self, job_id: str) -> int:
+        """Max requeues per task: the job's own setting if the client sent
+        one, else the scheduler's config default."""
+        settings = self.get_job_settings(job_id)
+        raw = settings.get(BALLISTA_MAX_TASK_RETRIES)
+        if raw is not None:
+            try:
+                return max(0, int(raw))
+            except ValueError:
+                log.warning("job %s: bad %s=%r, using scheduler default",
+                            job_id, BALLISTA_MAX_TASK_RETRIES, raw)
+        return self.config.max_task_retries()
+
+    def requeue_task(
+        self, t: pb.TaskStatus, executor_id: str, error: str, limit: int
+    ) -> bool:
+        """Put a failed/lost task back to pending for attempt N+1, recording
+        attempt N (executor + error) in the history. Returns False without
+        writing when the retry budget is exhausted — the caller fails the
+        job with the full history instead."""
+        if t.attempt >= limit:
+            return False
+        pending = pb.TaskStatus()
+        pending.partition_id.CopyFrom(t.partition_id)
+        pending.attempt = t.attempt + 1
+        pending.history.MergeFrom(t.history)
+        h = pending.history.add()
+        h.attempt = t.attempt
+        h.executor_id = executor_id
+        h.error = error
+        self.save_task_status(pending)
+        _record_recovery("task_retry")
+        pid = t.partition_id
+        log.warning(
+            "requeued task %s/%s/%s for attempt %d (%s)",
+            pid.job_id, pid.stage_id, pid.partition_id, pending.attempt, error,
+        )
+        return True
+
+    def _fail_job(self, job_id: str, error: str) -> None:
+        failed = pb.JobStatus()
+        failed.failed.error = error
+        self.save_job_metadata(job_id, failed)
+        _record_recovery("job_failed_exhausted")
+        log.error("job %s failed: %s", job_id, error)
+
+    def get_job_stage_ids(self, job_id: str) -> List[int]:
+        out = []
+        for k, _v in self.kv.get_prefix(self._key("stages", job_id) + "/"):
+            try:
+                out.append(int(k.rsplit("/", 1)[1]))
+            except ValueError:
+                continue
+        return out
+
+    def _downstream_stages(self, job_id: str, lost_stages: Set[int]) -> Set[int]:
+        """Stage ids whose plans read (via UnresolvedShuffle) any stage in
+        lost_stages — the consumers a lost map output invalidates."""
+        out: Set[int] = set()
+        for sid in self.get_job_stage_ids(job_id):
+            plan = self.get_stage_plan(job_id, sid)
+            if plan is None:
+                continue
+            if any(u.stage_id in lost_stages for u in find_unresolved_shuffles(plan)):
+                out.add(sid)
+        return out
+
     def reset_lost_tasks(self) -> int:
         """Re-schedule work lost to dead executors (beyond the reference,
         which loses in-flight work permanently — SURVEY §5 'no retry').
 
         A task RUNNING on an executor whose lease expired goes back to
         pending; a COMPLETED task whose output lives on a dead executor also
-        goes back to pending (its shuffle files are unreachable), which
-        recursively invalidates dependents via the normal runnability check.
-        Returns the number of tasks reset."""
+        goes back to pending (its shuffle files are unreachable). Lineage
+        pass: downstream stage tasks RUNNING against those lost locations
+        are invalidated too (their in-flight fetches would only fetch_fail
+        later), and the normal runnability check blocks them until the map
+        partitions are recomputed. Every reset consumes one retry from the
+        task's budget; a task out of budget fails the job with its full
+        attempt history. Returns the number of tasks reset."""
         alive = {m.id for m in self.get_executors_metadata()}
         finished_jobs: Dict[str, bool] = {}
+        limits: Dict[str, int] = {}
+        # job -> stages whose COMPLETED outputs were lost (lineage roots)
+        lost_outputs: Dict[str, Set[int]] = {}
         reset = 0
-        for t in self.get_all_tasks():
-            job_id = t.partition_id.job_id
+
+        def job_finished(job_id: str) -> bool:
             if job_id not in finished_jobs:
                 js = self.get_job_metadata(job_id)
-                finished_jobs[job_id] = js is not None and js.WhichOneof("status") in (
-                    "completed",
-                    "failed",
-                )
-            if finished_jobs[job_id]:
+                finished_jobs[job_id] = js is not None and js.WhichOneof(
+                    "status"
+                ) in ("completed", "failed")
+            return finished_jobs[job_id]
+
+        def limit_of(job_id: str) -> int:
+            if job_id not in limits:
+                limits[job_id] = self.retry_limit(job_id)
+            return limits[job_id]
+
+        for t in self.get_all_tasks():
+            job_id = t.partition_id.job_id
+            if job_finished(job_id):
                 continue  # don't resurrect finished jobs
             w = t.WhichOneof("status")
             owner = None
@@ -255,12 +438,89 @@ class SchedulerState:
                 owner = t.running.executor_id
             elif w == "completed":
                 owner = t.completed.executor_id
-            if owner is not None and owner not in alive:
-                pending = pb.TaskStatus()
-                pending.partition_id.CopyFrom(t.partition_id)
-                self.save_task_status(pending)
-                reset += 1
+            if owner is None or owner in alive:
+                continue
+            error = (
+                f"executor {owner} lease expired while the task ran"
+                if w == "running"
+                else f"completed shuffle output lost with executor {owner}"
+            )
+            if not self.requeue_task(t, owner, error, limit_of(job_id)):
+                exhausted = pb.TaskStatus()
+                exhausted.CopyFrom(t)
+                exhausted.failed.error = error
+                exhausted.failed.executor_id = owner
+                self._fail_job(job_id, _attempts_error(exhausted))
+                finished_jobs[job_id] = True
+                continue
+            _record_recovery("lost_task_reset")
+            reset += 1
+            if w == "completed":
+                lost_outputs.setdefault(job_id, set()).add(t.partition_id.stage_id)
+
+        # lineage invalidation: running consumers of the lost outputs
+        for job_id, stages in lost_outputs.items():
+            for sid in self._downstream_stages(job_id, stages):
+                # an exhausted requeue below fails the job; stop touching
+                # its remaining stages/tasks (a failed job must not keep
+                # accumulating fresh pending work)
+                if job_finished(job_id):
+                    break
+                for t in self.get_stage_tasks(job_id, sid):
+                    if t.WhichOneof("status") != "running":
+                        continue
+                    error = (
+                        f"upstream shuffle locations of stage(s) "
+                        f"{sorted(stages)} lost mid-run"
+                    )
+                    if not self.requeue_task(
+                        t, t.running.executor_id, error, limit_of(job_id)
+                    ):
+                        exhausted = pb.TaskStatus()
+                        exhausted.CopyFrom(t)
+                        exhausted.failed.error = error
+                        exhausted.failed.executor_id = t.running.executor_id
+                        self._fail_job(job_id, _attempts_error(exhausted))
+                        finished_jobs[job_id] = True
+                        break
+                    _record_recovery("downstream_invalidated")
+                    reset += 1
         return reset
+
+    def handle_fetch_failed(self, t: pb.TaskStatus, limit: int) -> bool:
+        """Lineage-based recovery for one fetch_failed report: requeue the
+        reporting (reduce) task AND recompute the named lost map partition,
+        instead of failing the job. Returns False when the reporter's retry
+        budget is exhausted (caller fails the job)."""
+        ff = t.fetch_failed
+        pid = t.partition_id
+        _record_recovery("fetch_failed")
+        reporter_error = (
+            f"fetch_failed: shuffle output {ff.map_executor_id}:{ff.path} "
+            f"(map {ff.map_stage_id}/{ff.map_partition_id}) unreachable: {ff.error}"
+        )
+        if not self.requeue_task(t, ff.executor_id, reporter_error, limit):
+            return False
+        # recompute ONLY the named map partition — and only if its current
+        # completed output is the one reported lost (a concurrent reset or
+        # recompute may already have moved it)
+        mt = self.get_task_status(pid.job_id, ff.map_stage_id, ff.map_partition_id)
+        if (
+            mt is not None
+            and mt.WhichOneof("status") == "completed"
+            and mt.completed.executor_id == ff.map_executor_id
+        ):
+            if self.requeue_task(
+                mt,
+                ff.map_executor_id,
+                f"shuffle output lost (fetch_failed reported by {ff.executor_id})",
+                limit,
+            ):
+                _record_recovery("map_recomputed")
+            # else: the map partition is out of budget; its data is gone for
+            # good, so the reporter's retries will exhaust and fail the job
+            # with the full lineage in the error
+        return True
 
     # -- scheduling ---------------------------------------------------------
     def assign_next_schedulable_task(
@@ -277,6 +537,15 @@ class SchedulerState:
         randomized DAGs. Marks the pick Running and returns
         (status, bound plan)."""
         idx = self._ensure_task_index()
+        # per-task executor blacklist: attempt N+1 must not land on the
+        # executor that failed attempt N — unless it is the only executor
+        # left alive (progress beats placement when there is no choice)
+        alive_others = {
+            m.id for m in self.get_executors_metadata()
+        } - {executor_id}
+        # pending tasks of a terminal job must not be handed out (a failed
+        # job can leave requeued-then-exhausted pending work behind)
+        job_live: Dict[str, bool] = {}
         # KV keys order stage/partition ids as STRINGS ("10" < "2"); the
         # scan this replaces inherited that order from get_prefix
         for job_id, stage_id in sorted(
@@ -286,6 +555,13 @@ class SchedulerState:
             # drained (and dropped) this stage's entry mid-iteration
             parts = idx.pending.get((job_id, stage_id))
             if not parts:
+                continue
+            if job_id not in job_live:
+                js = self.get_job_metadata(job_id)
+                job_live[job_id] = js is None or js.WhichOneof("status") not in (
+                    "completed", "failed",
+                )
+            if not job_live[job_id]:
                 continue
             plan = self.get_stage_plan(job_id, stage_id)
             if plan is None:
@@ -318,53 +594,137 @@ class SchedulerState:
                     host, port = (meta.host, meta.port) if meta else ("", 0)
                     locs.append(
                         ShuffleLocation(
-                            t.completed.executor_id, host, port, t.completed.path
+                            t.completed.executor_id,
+                            host,
+                            port,
+                            t.completed.path,
+                            stage_id=u.stage_id,
+                            map_partition=t.partition_id.partition_id,
                         )
                     )
                 locations[u.stage_id] = locs
             if blocked:
                 continue
             bound = remove_unresolved_shuffles(plan, locations) if unresolved else plan
-            partition = min(parts, key=str)
-            # re-verify from the KV before claiming: the index is local to
-            # this SchedulerState; a peer scheduler (or an expired write)
-            # must not lead to a double assignment
-            current = self.get_task_status(job_id, stage_id, partition)
-            if current is None or current.WhichOneof("status") is not None:
-                if current is None:
-                    idx.pending[(job_id, stage_id)].discard(partition)
-                else:
-                    idx.observe(current)
-                continue
-            running = pb.TaskStatus()
-            running.partition_id.CopyFrom(current.partition_id)
-            running.running.executor_id = executor_id
-            self.save_task_status(running)
-            return running, bound
+            for partition in sorted(parts, key=str):
+                # re-verify from the KV before claiming: the index is local
+                # to this SchedulerState; a peer scheduler (or an expired
+                # write) must not lead to a double assignment
+                current = self.get_task_status(job_id, stage_id, partition)
+                if current is None or current.WhichOneof("status") is not None:
+                    if current is None:
+                        idx.pending[(job_id, stage_id)].discard(partition)
+                    else:
+                        idx.observe(current)
+                    continue
+                if (
+                    current.history
+                    and current.history[-1].executor_id == executor_id
+                    and alive_others
+                ):
+                    # blacklist: this executor failed the previous attempt;
+                    # leave the task for a peer (another partition may still
+                    # fit this executor)
+                    continue
+                running = pb.TaskStatus()
+                running.CopyFrom(current)  # keep attempt + history
+                running.running.executor_id = executor_id
+                self.save_task_status(running)
+                self._assigned[(job_id, stage_id, partition)] = (
+                    executor_id, running.attempt, time.monotonic(),
+                )
+                return running, bound
         return None
+
+    def reconcile_running_tasks(self, executor_id: str, running) -> int:
+        """Requeue assignments lost in transit: a ledger entry past the
+        grace period whose KV status is still Running on `executor_id` but
+        which that executor's poll no longer (or never) echoes in
+        running_tasks means the PollWork response carrying the assignment
+        never arrived — without this the task is orphaned forever (the
+        owner's lease stays fresh, so reset_lost_tasks never fires).
+        Returns the number of reclaimed assignments."""
+        now = time.monotonic()
+        running_keys = {
+            (p.job_id, p.stage_id, p.partition_id) for p in running
+        }
+        reclaimed = 0
+        for key, (owner, attempt, t0) in list(self._assigned.items()):
+            if now - t0 < ORPHANED_ASSIGNMENT_GRACE_SECS:
+                continue
+            cur = self.get_task_status(*key)
+            if (
+                cur is None
+                or cur.WhichOneof("status") != "running"
+                or cur.attempt != attempt
+                or cur.running.executor_id != owner
+            ):
+                del self._assigned[key]  # resolved or superseded elsewhere
+                continue
+            if owner != executor_id:
+                continue  # only the owner's polls can vouch for it
+            del self._assigned[key]
+            if key in running_keys:
+                continue  # confirmed started; status/lease machinery takes over
+            error = (
+                f"assignment never reached executor {owner} "
+                "(PollWork response lost in transit)"
+            )
+            if self.requeue_task(cur, owner, error, self.retry_limit(key[0])):
+                _record_recovery("orphan_reassigned")
+                reclaimed += 1
+            else:
+                exhausted = pb.TaskStatus()
+                exhausted.CopyFrom(cur)
+                exhausted.failed.error = error
+                exhausted.failed.executor_id = owner
+                self._fail_job(key[0], _attempts_error(exhausted))
+        return reclaimed
 
     # -- job status fold ------------------------------------------------------
     def synchronize_job_status(self, job_id: str) -> None:
-        """Fold task statuses into the job status (ref state/mod.rs:267-358)."""
+        """Fold task statuses into the job status (ref state/mod.rs:267-358)
+        — through the retry policy: a failed task inside its retry budget is
+        requeued (with the attempt recorded in its history) instead of
+        failing the job, a fetch_failed task additionally recomputes the
+        lost map partition (lineage), and only an exhausted task fails the
+        job — with every attempt listed in the error."""
         current = self.get_job_metadata(job_id)
-        if current is not None and current.WhichOneof("status") == "queued":
+        which_job = current.WhichOneof("status") if current is not None else None
+        if which_job == "queued":
             # still being planned; tasks not yet created
+            return
+        if which_job in ("completed", "failed"):
+            # terminal: late task reports must not resurrect the job
             return
         tasks = self.get_job_tasks(job_id)
         if not tasks:
             return
+        limit = self.retry_limit(job_id)
         status = pb.JobStatus()
         any_failed = None
         all_completed = True
         for t in tasks:
             w = t.WhichOneof("status")
             if w == "failed":
-                any_failed = t.failed.error
+                if self.requeue_task(
+                    t, t.failed.executor_id, t.failed.error, limit
+                ):
+                    all_completed = False
+                    continue
+                any_failed = _attempts_error(t)
+                break
+            if w == "fetch_failed":
+                if self.handle_fetch_failed(t, limit):
+                    all_completed = False
+                    continue
+                any_failed = _attempts_error(t)
                 break
             if w != "completed":
                 all_completed = False
         if any_failed is not None:
             status.failed.error = any_failed
+            _record_recovery("job_failed_exhausted")
         elif all_completed:
             final_stage = max(t.partition_id.stage_id for t in tasks)
             for t in sorted(tasks, key=lambda t: t.partition_id.partition_id):

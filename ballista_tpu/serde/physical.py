@@ -216,6 +216,10 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             pl.executor_meta.host = loc.host
             pl.executor_meta.port = loc.port
             pl.path = loc.path
+            # lineage of the producing map task, so a failed fetch can name
+            # exactly what the scheduler must recompute
+            pl.partition_id.stage_id = loc.stage_id
+            pl.partition_id.partition_id = loc.map_partition
         n.shuffle_reader.schema_ipc = schema_to_ipc(plan.schema())
         n.shuffle_reader.num_partitions = plan.num_partitions
         n.shuffle_reader.identity = plan.identity
@@ -396,7 +400,12 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
     if which == "shuffle_reader":
         locs = [
             ShuffleLocation(
-                pl.executor_meta.id, pl.executor_meta.host, pl.executor_meta.port, pl.path
+                pl.executor_meta.id,
+                pl.executor_meta.host,
+                pl.executor_meta.port,
+                pl.path,
+                stage_id=pl.partition_id.stage_id,
+                map_partition=pl.partition_id.partition_id,
             )
             for pl in n.shuffle_reader.partition_locations
         ]

@@ -1,0 +1,95 @@
+"""Deterministic fault-injection harness.
+
+At "heavy traffic from millions of users" scale, transient executor death
+and flaky fetches are the steady state — the recovery machinery
+(scheduler/state.py retries + lineage recompute, rpc backoff) must be
+exercisable in CI without wall-clock or RNG flake. Every injection point is
+
+- **registered**: a site name from SITES, checked at call time (and by the
+  ballista-lint failure-discipline rule: no ad-hoc `random` raises);
+- **site-addressable**: enabled per-site via ``ballista.chaos.sites``;
+- **deterministic**: the verdict for (seed, site, key) is a pure function —
+  sha256 of the triple against ``ballista.chaos.rate`` — so a chaos run is
+  reproducible regardless of thread interleaving, and retried attempts
+  rotate the key (attempt number is part of it) to draw a fresh verdict.
+
+Wired through the existing seams (TaskContext/config plumbing), never by
+monkeypatching: chaos tests run whole SQL jobs under injected faults and
+assert results are bit-identical to the fault-free run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+from typing import Optional
+
+from ballista_tpu.errors import RpcError
+
+log = logging.getLogger("ballista.chaos")
+
+# The registered injection sites. Adding a site means adding it HERE first;
+# call sites naming anything else raise (and fail ballista-lint).
+SITES = (
+    "flight.fetch",     # shuffle piece fetch (distributed/stages.py)
+    "rpc.call",         # scheduler gRPC client call (scheduler/rpc.py)
+    "task.execute",     # task execution on the executor (execution_loop.py)
+    "kv.put",           # scheduler KV write (scheduler/state.py)
+    "executor.death",   # executor hard-death (execution_loop.py run loop)
+)
+
+_DENOM = float(1 << 64)
+
+
+class ChaosInjected(RpcError):
+    """Synthetic fault raised by a registered injection site. Subclasses
+    RpcError so transport-shaped seams treat it exactly like the real
+    failure they are rehearsing."""
+
+    def __init__(self, site: str, key: str) -> None:
+        super().__init__(f"chaos[{site}] injected fault (key={key})")
+        self.site = site
+        self.key = key
+
+
+class ChaosInjector:
+    """Seeded, site-addressable fault decisions (see module docstring)."""
+
+    def __init__(self, seed: int, rate: float, sites=None) -> None:
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"chaos rate must be in [0, 1], got {rate}")
+        unknown = set(sites or ()) - set(SITES)
+        if unknown:
+            raise ValueError(
+                f"unregistered chaos sites {sorted(unknown)}; known: {SITES}"
+            )
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.sites = frozenset(sites) if sites else frozenset(SITES)
+
+    def should_inject(self, site: str, key: str) -> bool:
+        """Deterministic verdict for (seed, site, key); no state mutated."""
+        if site not in SITES:
+            raise ValueError(f"unregistered chaos site {site!r}; known: {SITES}")
+        if site not in self.sites or self.rate <= 0.0:
+            return False
+        h = hashlib.sha256(f"{self.seed}:{site}:{key}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / _DENOM < self.rate
+
+    def maybe_fail(self, site: str, key: str) -> None:
+        """Raise ChaosInjected iff should_inject — the one raising seam."""
+        if self.should_inject(site, key):
+            from ballista_tpu.ops.runtime import record_recovery
+
+            record_recovery("chaos_injected")
+            log.warning("chaos[%s] injecting fault (key=%s)", site, key)
+            raise ChaosInjected(site, key)
+
+
+def chaos_from_config(config) -> Optional[ChaosInjector]:
+    """Build an injector from ballista.chaos.* settings; None when disarmed
+    (rate == 0) so hot paths stay a single attribute check."""
+    rate = config.chaos_rate()
+    if rate <= 0.0:
+        return None
+    return ChaosInjector(config.chaos_seed(), rate, config.chaos_sites())

@@ -8,8 +8,11 @@ Prints ONE JSON line:
                SF=10, the two taxi shapes, and q1/q3/q5/q6 at SF=100 when
                the dataset is on disk — each {"name", "sf", "tpu_ms",
                "cpu_ms", "speedup"} plus optional "ingest"/"readback"
-               accounting and "join_paths" (device / step_aside /
-               host_fallback counts with decline reasons)]}
+               accounting, "join_paths" (device / step_aside /
+               host_fallback counts with decline reasons), and "recovery"
+               (retry / lineage-recompute / rpc-retry / chaos-injection
+               event totals — nonzero under ballista.chaos.* or real
+               faults)]}
 
 Reference baseline context: the reference publishes no numbers
 (BASELINE.md); the denominator here is this repo's own host Arrow path —
@@ -316,6 +319,23 @@ def _join_snapshot(iters: int = 1) -> dict | None:
     return out
 
 
+def _recovery_snapshot() -> dict | None:
+    """Drain the failure-recovery accumulator (ops/runtime.py): task
+    retries, lineage recomputes (fetch_failed/map_recomputed), lost-task
+    resets, transient-RPC retries, and chaos injections since the last
+    drain. Raw event TOTALS, never per-query — recovery work is driven by
+    faults, not by the query loop shape. None on a fault-free run (the
+    common case: every counter zero)."""
+    try:
+        from ballista_tpu.ops.runtime import recovery_stats
+
+        s = recovery_stats(reset=True)
+    except Exception:
+        return None
+    s = {k: v for k, v in s.items() if v}
+    return s or None
+
+
 def _ingest_snapshot() -> dict | None:
     """Drain the ingest-timing accumulator (ops/runtime.py): scan/encode/
     upload seconds and the overlap fraction of the stage prepares since the
@@ -354,9 +374,11 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         ingest = _ingest_snapshot()  # fresh prepares happen at warmup
         _readback_snapshot()  # drain: attribute readbacks to the timed runs
         _join_snapshot()  # drain: attribute join paths to the timed runs
+        _recovery_snapshot()  # drain: attribute recovery events likewise
         t = min(run_once("tpu", sql, sf) for _ in range(iters))
         readback = _per_query(_readback_snapshot(), iters)
         join_paths = _join_snapshot(iters)
+        recovery = _recovery_snapshot()
         run_once("cpu", sql, sf)
         c = min(run_once("cpu", sql, sf) for _ in range(iters))
     except Exception as e:
@@ -390,6 +412,10 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
                 else "TOTALS (uneven loop)")
         print(f"[join] {name} sf={sf}: {counts} "
               f"reasons={join_paths.get('reasons', {})} ({unit})",
+              file=sys.stderr)
+    if recovery is not None:
+        row["recovery"] = recovery
+        print(f"[recovery] {name} sf={sf}: {recovery} (event totals)",
               file=sys.stderr)
     print(f"[config] {name} sf={sf}: tpu={row['tpu_ms']}ms "
           f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x", file=sys.stderr)

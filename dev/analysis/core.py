@@ -38,6 +38,7 @@ def _load_rules() -> None:
     from dev.analysis import (  # noqa: F401
         rules_decline,
         rules_dtype,
+        rules_failure,
         rules_guarded,
         rules_readback,
         rules_tracer,

@@ -50,3 +50,12 @@ python -m benchmarks.compare --data "$DATA" \
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_floatbits.py tests/test_topk_epilogue.py \
     tests/test_join_multiplicity.py
+
+# strict gate on failure recovery (ISSUE 5): bounded retries with attempt
+# history, lineage-based shuffle recovery (fetch_failed -> map recompute),
+# the poll-loop TOCTOU fix, transient-RPC backoff, and the seeded chaos
+# acceptance runs. Chaos verdicts are pure functions of (seed, site,
+# plan-coordinate key) — no wall-clock or RNG flake by construction — and
+# the chaos runs must stay bit-identical to the fault-free runs.
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_chaos.py tests/test_fault_tolerance.py

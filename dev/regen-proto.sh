@@ -12,3 +12,5 @@ from ballista_tpu.proto import ballista_pb2 as pb
 n = pb.PhysicalPlanNode()
 print("regenerated ballista_pb2.py; smoke import ok:", bool(n.DESCRIPTOR))
 PY
+# If protoc is unavailable on this image, apply descriptor-level additions
+# with dev/patch_proto.py instead (see proto/README.md).

@@ -1,0 +1,257 @@
+"""Deterministic fault injection (utils/chaos.py) + chaos acceptance runs.
+
+Chaos runs are SEEDED: every injection verdict is a pure function of
+(seed, site, key), keys are built from plan coordinates (never job ids,
+paths, or wall clock), so the same seed faults the same work every run —
+no flake — and the recovery machinery must deliver results BIT-IDENTICAL
+to the fault-free run."""
+
+import time
+
+import pyarrow as pa
+import pytest
+
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.errors import RpcError
+from ballista_tpu.utils.chaos import (
+    SITES,
+    ChaosInjected,
+    ChaosInjector,
+    chaos_from_config,
+)
+
+# -- injector unit behavior -------------------------------------------------
+
+
+def test_verdicts_are_deterministic_and_instance_free():
+    a = ChaosInjector(seed=7, rate=0.5)
+    b = ChaosInjector(seed=7, rate=0.5)
+    keys = [f"1/{i}@a0" for i in range(64)]
+    va = [a.should_inject("task.execute", k) for k in keys]
+    vb = [b.should_inject("task.execute", k) for k in keys]
+    assert va == vb
+    assert any(va) and not all(va)
+    # a different seed draws a different fault pattern
+    c = ChaosInjector(seed=8, rate=0.5)
+    assert va != [c.should_inject("task.execute", k) for k in keys]
+
+
+def test_rate_bounds():
+    never = ChaosInjector(seed=1, rate=0.0)
+    always = ChaosInjector(seed=1, rate=1.0)
+    for i in range(32):
+        assert not never.should_inject("flight.fetch", str(i))
+        assert always.should_inject("flight.fetch", str(i))
+    with pytest.raises(ValueError):
+        ChaosInjector(seed=1, rate=1.5)
+
+
+def test_rate_is_approximately_honored():
+    inj = ChaosInjector(seed=3, rate=0.3)
+    hits = sum(inj.should_inject("kv.put", f"put{i}") for i in range(2000))
+    assert 0.25 < hits / 2000 < 0.35
+
+
+def test_unregistered_sites_are_rejected():
+    inj = ChaosInjector(seed=1, rate=1.0)
+    with pytest.raises(ValueError, match="unregistered"):
+        inj.should_inject("made.up", "k")
+    with pytest.raises(ValueError, match="unregistered"):
+        ChaosInjector(seed=1, rate=1.0, sites={"task.execute", "nope"})
+
+
+def test_site_filter_disarms_other_sites():
+    inj = ChaosInjector(seed=1, rate=1.0, sites={"kv.put"})
+    assert inj.should_inject("kv.put", "k")
+    assert not inj.should_inject("task.execute", "k")
+
+
+def test_maybe_fail_raises_rpc_shaped_error():
+    inj = ChaosInjector(seed=1, rate=1.0)
+    with pytest.raises(ChaosInjected) as ei:
+        inj.maybe_fail("rpc.call", "PollWork/1")
+    assert isinstance(ei.value, RpcError)
+    assert "rpc.call" in str(ei.value)
+
+
+def test_chaos_from_config():
+    assert chaos_from_config(BallistaConfig()) is None  # rate 0 = disarmed
+    cfg = BallistaConfig({
+        "ballista.chaos.rate": "0.25",
+        "ballista.chaos.seed": "42",
+        "ballista.chaos.sites": "task.execute, flight.fetch",
+    })
+    inj = chaos_from_config(cfg)
+    assert inj is not None and inj.seed == 42 and inj.rate == 0.25
+    assert inj.sites == frozenset({"task.execute", "flight.fetch"})
+    assert set(SITES) >= inj.sites
+
+
+# -- seeded chaos acceptance runs -------------------------------------------
+
+GROUP_BY_SQL = (
+    "select region, sum(amount) as s, count(*) as n from sales "
+    "group by region order by region"
+)
+JOIN_SQL = (
+    "select region, sum(amount * bonus) as weighted from sales, regions "
+    "where region = name group by region order by region"
+)
+
+# pinned: verdicts are a pure function of (seed, site, plan-coordinate key),
+# so this seed injects the same faults on every run of these queries
+CHAOS_SEED = 11
+CHAOS_SETTINGS = {
+    "ballista.chaos.rate": "0.10",
+    "ballista.chaos.seed": str(CHAOS_SEED),
+    "ballista.chaos.sites": "task.execute,flight.fetch",
+    "ballista.shuffle.max_task_retries": "5",
+    "ballista.shuffle.partitions": "4",
+}
+CLEAN_SETTINGS = {"ballista.shuffle.partitions": "4"}
+
+
+def _register(ctx, sales_table):
+    ctx.register_record_batches("sales", sales_table, n_partitions=4)
+    ctx.register_record_batches(
+        "regions",
+        pa.table({"name": ["east", "west", "north"], "bonus": [1.0, 2.0, 3.0]}),
+    )
+
+
+def _run_queries(settings, sales_table, n_executors=2, cluster_config=None):
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    cluster = StandaloneCluster(
+        n_executors=n_executors, config=cluster_config or BallistaConfig()
+    )
+    try:
+        ctx = BallistaContext(*cluster.scheduler_addr, settings=settings)
+        _register(ctx, sales_table)
+        out = {}
+        for name, sql in (("group_by", GROUP_BY_SQL), ("join", JOIN_SQL)):
+            out[name] = ctx.sql(sql).collect()
+        ctx.close()
+        return out
+    finally:
+        cluster.shutdown()
+
+
+def test_chaos_run_is_bit_identical_to_fault_free_run(sales_table):
+    """ISSUE 5 acceptance: a seeded chaos run (task + fetch faults) of the
+    distributed group-by and join queries completes with results
+    bit-identical to the fault-free run, and the recovery counters show the
+    faults actually fired and were recovered from."""
+    from ballista_tpu.ops.runtime import recovery_stats
+
+    clean = _run_queries(CLEAN_SETTINGS, sales_table)
+    recovery_stats(reset=True)
+    chaotic = _run_queries(CHAOS_SETTINGS, sales_table)
+    stats = recovery_stats(reset=True)
+    for name in ("group_by", "join"):
+        assert chaotic[name].equals(clean[name]), (
+            name, chaotic[name].to_pydict(), clean[name].to_pydict(),
+        )
+    assert stats.get("chaos_injected", 0) > 0, stats
+    assert stats.get("task_retry", 0) > 0, stats
+
+
+def test_chaos_exhaustion_error_lists_every_attempt(sales_table):
+    """ISSUE 5 acceptance: rate=1.0 defeats every retry; the job error
+    after exhaustion names each attempt (executor + cause)."""
+    from ballista_tpu.errors import ExecutionError
+
+    settings = {
+        "ballista.chaos.rate": "1.0",
+        "ballista.chaos.seed": "1",
+        "ballista.chaos.sites": "task.execute",
+        "ballista.shuffle.max_task_retries": "1",
+        "ballista.shuffle.partitions": "2",
+    }
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    cluster = StandaloneCluster(n_executors=2)
+    try:
+        ctx = BallistaContext(*cluster.scheduler_addr, settings=settings)
+        _register(ctx, sales_table)
+        with pytest.raises(ExecutionError) as ei:
+            ctx.sql(GROUP_BY_SQL).collect()
+        msg = str(ei.value)
+        assert "attempt 0 on " in msg and "attempt 1 on " in msg, msg
+        assert "chaos[task.execute]" in msg
+        assert "after 2 attempt(s)" in msg
+        ctx.close()
+    finally:
+        cluster.shutdown()
+
+
+def _find_death_seed():
+    """Deterministically scan for a seed where executor local-0 dies within
+    its first few polls and local-1 survives the whole run — pure hashing,
+    no cluster involved, so the scan result is stable forever."""
+    for seed in range(2000):
+        inj = ChaosInjector(seed, rate=0.005, sites={"executor.death"})
+
+        def death_poll(eid, horizon):
+            for n in range(1, horizon):
+                if inj.should_inject("executor.death", f"{eid}/poll{n}"):
+                    return n
+            return None
+
+        d0 = death_poll("local-0", 17)
+        if d0 is not None and 4 <= d0 and death_poll("local-1", 400) is None:
+            return seed
+    pytest.fail("no death seed found in scan range")
+
+
+def test_chaos_executor_death_recovers_bit_identical(sales_table):
+    """ISSUE 5 acceptance: executor-death + fetch-fault injection in one
+    seeded run — one executor chaos-dies mid-job (heartbeat AND data plane),
+    the survivor recomputes, results stay bit-identical."""
+    import ballista_tpu.scheduler.state as state_mod
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import recovery_stats
+
+    death_seed = _find_death_seed()
+    clean = _run_queries(CLEAN_SETTINGS, sales_table)
+
+    cluster_config = BallistaConfig({
+        "ballista.chaos.rate": "0.005",
+        "ballista.chaos.seed": str(death_seed),
+        "ballista.chaos.sites": "executor.death",
+    })
+    old_lease = state_mod.EXECUTOR_LEASE_SECS
+    state_mod.EXECUTOR_LEASE_SECS = 1.0
+    recovery_stats(reset=True)
+    cluster = StandaloneCluster(n_executors=2, config=cluster_config)
+    cluster.scheduler_impl.lost_task_check_interval = 0.3
+    try:
+        ctx = BallistaContext(*cluster.scheduler_addr, settings=CHAOS_SETTINGS)
+        _register(ctx, sales_table)
+        out = {}
+        for name, sql in (("group_by", GROUP_BY_SQL), ("join", JOIN_SQL)):
+            try:
+                out[name] = ctx.sql(sql).collect()
+            except RpcError:
+                # narrow race: the job completed with final partitions on
+                # the executor that chaos-killed right after — resubmit once
+                # (a job-level restart is future work; recovery of IN-FLIGHT
+                # jobs is what this test pins)
+                out[name] = ctx.sql(sql).collect()
+        ctx.close()
+        for name in ("group_by", "join"):
+            assert out[name].equals(clean[name]), (
+                name, out[name].to_pydict(), clean[name].to_pydict(),
+            )
+        stats = recovery_stats(reset=True)
+        assert stats.get("chaos_injected", 0) > 0, stats
+        # the dying executor's chaos verdict is deterministic; whether its
+        # death interrupts live work depends on scheduling, so only the
+        # injection itself is asserted unconditionally
+        assert stats.get("chaos_executor_death", 0) >= 1, stats
+    finally:
+        state_mod.EXECUTOR_LEASE_SECS = old_lease
+        cluster.shutdown()

@@ -1,5 +1,14 @@
-"""Fault tolerance & recovery: lost-task rescheduling, scheduler restart
-resume (checkpointed state, SURVEY §5), work-dir GC."""
+"""Fault tolerance & recovery: bounded task retries, lineage-based shuffle
+recovery, lost-task rescheduling, scheduler restart resume (checkpointed
+state), work-dir GC, transient-RPC backoff.
+
+SURVEY §5 noted the reference has ~~"no retry"~~ — **no longer true of this
+port** (ISSUE 5): a failed task is requeued up to
+``ballista.shuffle.max_task_retries`` times with per-task executor
+blacklisting, a dead executor's completed shuffle outputs are recomputed
+via lineage (downstream consumers invalidated, fetch_failed statuses name
+the lost location), and only retry exhaustion fails the job — with the full
+attempt history in the error."""
 
 import os
 import time
@@ -101,6 +110,532 @@ def test_end_to_end_recovery_after_executor_death(sales_table):
             "select region, sum(amount) as s from sales group by region order by region"
         ).collect()
         assert out.column("s").to_pylist() == [120.0, 40.0, 145.0]
+        ctx.close()
+    finally:
+        state_mod.EXECUTOR_LEASE_SECS = old_lease
+        cluster.shutdown()
+
+
+# -- bounded retries + attempt history (ISSUE 5) ----------------------------
+
+def _running_job(s, job="j"):
+    running = pb.JobStatus()
+    running.running.SetInParent()
+    s.save_job_metadata(job, running)
+
+
+def test_reset_preserves_attempt_history():
+    """A lost-task reset consumes one retry: attempt increments and the
+    history names the dead executor."""
+    s = SchedulerState(MemoryBackend(), "t")
+    _running_job(s)
+    s.save_executor_metadata(_meta("e1"))
+    s.save_task_status(_task("j", 1, 0, "completed", "gone"))
+    assert s.reset_lost_tasks() == 1
+    t = s.get_task_status("j", 1, 0)
+    assert t.WhichOneof("status") is None and t.attempt == 1
+    assert len(t.history) == 1 and t.history[0].executor_id == "gone"
+    assert "shuffle output lost" in t.history[0].error
+
+
+def test_reset_exhausted_fails_job_with_full_history():
+    from ballista_tpu.config import BallistaConfig
+
+    s = SchedulerState(
+        MemoryBackend(), "t",
+        config=BallistaConfig({"ballista.shuffle.max_task_retries": "1"}),
+    )
+    _running_job(s)
+    s.save_executor_metadata(_meta("e1"))
+    t = _task("j", 1, 0, "completed", "gone")
+    t.attempt = 1
+    h = t.history.add()
+    h.attempt = 0
+    h.executor_id = "gone"
+    h.error = "earlier loss"
+    s.save_task_status(t)
+    assert s.reset_lost_tasks() == 0
+    js = s.get_job_metadata("j")
+    assert js.WhichOneof("status") == "failed"
+    # every attempt is listed
+    assert "attempt 0 on gone: earlier loss" in js.failed.error
+    assert "attempt 1 on gone" in js.failed.error
+
+
+def test_failed_task_requeues_then_exhausts_listing_every_attempt():
+    """The retry fold end to end at the state level: N failures requeue,
+    failure N+1 fails the job with all attempts in the error."""
+    from ballista_tpu.config import BallistaConfig
+
+    s = SchedulerState(
+        MemoryBackend(), "t",
+        config=BallistaConfig({"ballista.shuffle.max_task_retries": "2"}),
+    )
+    _running_job(s)
+    for attempt, executor in enumerate(["e1", "e2", "e1"]):
+        t = s.get_task_status("j", 1, 0) or _task("j", 1, 0)
+        report = pb.TaskStatus()
+        report.CopyFrom(t)
+        report.failed.error = f"boom{attempt}"
+        report.failed.executor_id = executor
+        assert s.accept_task_status(report)
+        s.synchronize_job_status("j")
+        if attempt < 2:
+            cur = s.get_task_status("j", 1, 0)
+            assert cur.WhichOneof("status") is None
+            assert cur.attempt == attempt + 1
+            assert s.get_job_metadata("j").WhichOneof("status") == "running"
+    js = s.get_job_metadata("j")
+    assert js.WhichOneof("status") == "failed"
+    for line in ("attempt 0 on e1: boom0", "attempt 1 on e2: boom1",
+                 "attempt 2 on e1: boom2"):
+        assert line in js.failed.error, js.failed.error
+
+
+def test_stale_report_from_reset_attempt_is_dropped():
+    s = SchedulerState(MemoryBackend(), "t")
+    _running_job(s)
+    requeued = _task("j", 1, 0)
+    requeued.attempt = 2
+    s.save_task_status(requeued)
+    stale = _task("j", 1, 0, "completed", "e-old")
+    stale.attempt = 1  # the attempt the scheduler already reset
+    assert not s.accept_task_status(stale)
+    assert s.get_task_status("j", 1, 0).WhichOneof("status") is None
+
+
+def test_assignment_blacklists_last_failing_executor():
+    """Attempt N+1 must not land on the executor that failed attempt N —
+    unless it is the only one left alive."""
+    from ballista_tpu.physical.basic import EmptyExec
+
+    s = SchedulerState(MemoryBackend(), "t")
+    _running_job(s)
+    s.save_executor_metadata(_meta("e1", 1))
+    s.save_executor_metadata(_meta("e2", 2))
+    s.save_stage_plan("j", 1, EmptyExec(True, pa.schema([("a", pa.int64())])))
+    t = _task("j", 1, 0)
+    t.attempt = 1
+    h = t.history.add()
+    h.attempt = 0
+    h.executor_id = "e1"
+    h.error = "boom"
+    s.save_task_status(t)
+    assert s.assign_next_schedulable_task("e1") is None  # blacklisted
+    got = s.assign_next_schedulable_task("e2")
+    assert got is not None and got[0].running.executor_id == "e2"
+    assert got[0].attempt == 1  # attempt rides the assignment
+
+    # sole survivor: with e2 gone, e1 gets it anyway (progress over placement)
+    s2 = SchedulerState(MemoryBackend(), "t")
+    _running_job(s2)
+    s2.save_executor_metadata(_meta("e1", 1))
+    s2.save_stage_plan("j", 1, EmptyExec(True, pa.schema([("a", pa.int64())])))
+    s2.save_task_status(t)
+    got = s2.assign_next_schedulable_task("e1")
+    assert got is not None and got[0].running.executor_id == "e1"
+
+
+# -- lineage-based shuffle recovery (ISSUE 5) -------------------------------
+
+def _two_stage_state(max_retries="3"):
+    """Stage 1 (map, 2 partitions) -> stage 2 (reduce) via an
+    UnresolvedShuffleExec, as the distributed planner lays jobs out."""
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.distributed.stages import UnresolvedShuffleExec
+    from ballista_tpu.physical.basic import EmptyExec
+
+    s = SchedulerState(
+        MemoryBackend(), "t",
+        config=BallistaConfig({"ballista.shuffle.max_task_retries": max_retries}),
+    )
+    _running_job(s)
+    schema = pa.schema([("a", pa.int64())])
+    s.save_stage_plan("j", 1, EmptyExec(True, pa.schema([("a", pa.int64())])))
+    s.save_stage_plan("j", 2, UnresolvedShuffleExec(1, schema, 2))
+    return s
+
+
+def test_lineage_completed_map_on_dead_executor_with_running_consumer():
+    """Satellite regression (pre-fix-failing): a COMPLETED map task on a
+    dead executor while a downstream reduce RUNS on a live executor. Before
+    ISSUE 5 the reset put the map back to pending but left the running
+    reduce bound to the dead location — its fetch failed, the failed status
+    killed the job (reference behavior: in-flight work lost). Now BOTH are
+    requeued with the loss recorded, and the job keeps running."""
+    s = _two_stage_state()
+    s.save_executor_metadata(_meta("e1"))  # alive; e2 never registered = dead
+    s.save_task_status(_task("j", 1, 0, "completed", "e2"))  # lost output
+    s.save_task_status(_task("j", 1, 1, "completed", "e1"))
+    s.save_task_status(_task("j", 2, 0, "running", "e1"))  # live consumer
+    n = s.reset_lost_tasks()
+    assert n == 2  # the lost map task AND its running consumer
+    mt = s.get_task_status("j", 1, 0)
+    assert mt.WhichOneof("status") is None and mt.attempt == 1
+    rt = s.get_task_status("j", 2, 0)
+    assert rt.WhichOneof("status") is None and rt.attempt == 1
+    assert "lost" in rt.history[0].error
+    # the map output on the LIVE executor is untouched
+    assert s.get_task_status("j", 1, 1).WhichOneof("status") == "completed"
+    assert s.get_job_metadata("j").WhichOneof("status") == "running"
+
+
+def test_fetch_failed_recomputes_only_the_lost_map_partition():
+    """A reduce task reporting fetch_failed names the lost location; the
+    scheduler requeues the reporter AND exactly that map partition."""
+    s = _two_stage_state()
+    s.save_executor_metadata(_meta("e1"))
+    s.save_executor_metadata(_meta("e2", 2))
+    s.save_task_status(_task("j", 1, 0, "completed", "e2"))
+    s.save_task_status(_task("j", 1, 1, "completed", "e1"))
+    report = _task("j", 2, 0)
+    report.fetch_failed.error = "connection refused"
+    report.fetch_failed.executor_id = "e1"
+    report.fetch_failed.map_stage_id = 1
+    report.fetch_failed.map_partition_id = 0
+    report.fetch_failed.map_executor_id = "e2"
+    report.fetch_failed.path = "/work/j/1/0"
+    assert s.accept_task_status(report)
+    s.synchronize_job_status("j")
+    assert s.get_job_metadata("j").WhichOneof("status") == "running"
+    # the reporter is requeued with the loss in its history
+    rt = s.get_task_status("j", 2, 0)
+    assert rt.WhichOneof("status") is None and rt.attempt == 1
+    assert "fetch_failed" in rt.history[0].error
+    # ONLY map partition 0 (the named one) is recomputed
+    assert s.get_task_status("j", 1, 0).WhichOneof("status") is None
+    assert s.get_task_status("j", 1, 0).attempt == 1
+    assert s.get_task_status("j", 1, 1).WhichOneof("status") == "completed"
+
+
+def test_orphaned_assignment_is_reconciled():
+    """PollWork is retried and not idempotent: if the response carrying an
+    assignment is lost, the task sits Running on an executor that never
+    heard of it (lease stays fresh — reset_lost_tasks can't help). The
+    executor's running_tasks echo lets the scheduler requeue it."""
+    import ballista_tpu.scheduler.state as state_mod
+    from ballista_tpu.physical.basic import EmptyExec
+
+    s = SchedulerState(MemoryBackend(), "t")
+    _running_job(s)
+    s.save_executor_metadata(_meta("e1"))
+    s.save_stage_plan("j", 1, EmptyExec(True, pa.schema([("a", pa.int64())])))
+    s.save_task_status(_task("j", 1, 0))
+    assert s.assign_next_schedulable_task("e1") is not None
+    # within the grace period an empty echo is fine (the executor may not
+    # have received/started the task yet)
+    assert s.reconcile_running_tasks("e1", []) == 0
+    assert s.get_task_status("j", 1, 0).WhichOneof("status") == "running"
+    old = state_mod.ORPHANED_ASSIGNMENT_GRACE_SECS
+    state_mod.ORPHANED_ASSIGNMENT_GRACE_SECS = 0.0
+    try:
+        assert s.reconcile_running_tasks("e1", []) == 1
+    finally:
+        state_mod.ORPHANED_ASSIGNMENT_GRACE_SECS = old
+    t = s.get_task_status("j", 1, 0)
+    assert t.WhichOneof("status") is None and t.attempt == 1
+    assert "lost in transit" in t.history[0].error
+
+
+def test_reconcile_keeps_confirmed_running_tasks():
+    import ballista_tpu.scheduler.state as state_mod
+    from ballista_tpu.physical.basic import EmptyExec
+
+    s = SchedulerState(MemoryBackend(), "t")
+    _running_job(s)
+    s.save_executor_metadata(_meta("e1"))
+    s.save_stage_plan("j", 1, EmptyExec(True, pa.schema([("a", pa.int64())])))
+    s.save_task_status(_task("j", 1, 0))
+    status, _plan = s.assign_next_schedulable_task("e1")
+    old = state_mod.ORPHANED_ASSIGNMENT_GRACE_SECS
+    state_mod.ORPHANED_ASSIGNMENT_GRACE_SECS = 0.0
+    try:
+        # a DIFFERENT executor's empty echo must not reclaim e1's task
+        assert s.reconcile_running_tasks("e2", []) == 0
+        assert s.get_task_status("j", 1, 0).WhichOneof("status") == "running"
+        # the owner vouches for the task: nothing reclaimed, stays running
+        assert s.reconcile_running_tasks("e1", [status.partition_id]) == 0
+        assert s.get_task_status("j", 1, 0).WhichOneof("status") == "running"
+    finally:
+        state_mod.ORPHANED_ASSIGNMENT_GRACE_SECS = old
+
+
+# -- transient RPC resilience (ISSUE 5) -------------------------------------
+
+class _FakeGrpcError(Exception):
+    def __init__(self, code, detail="go away"):
+        self._code = code
+        self._detail = detail
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._detail
+
+
+def _client_with_stub(stub, retries=3):
+    """SchedulerGrpcClient whose PollWork stub is replaced — no server."""
+    import grpc
+
+    from ballista_tpu.scheduler.rpc import SchedulerGrpcClient
+
+    c = SchedulerGrpcClient("127.0.0.1", 1, channel=grpc.insecure_channel(
+        "127.0.0.1:1"), retries=retries, backoff_s=0.0)
+    c._stubs["PollWork"] = stub
+    c._stubs["GetFileMetadata"] = stub
+    return c
+
+
+def test_rpc_retries_unavailable_then_succeeds(monkeypatch):
+    import grpc
+
+    # grpc.RpcError is the catch target; fake must subclass it
+    class Boom(grpc.RpcError, _FakeGrpcError):
+        def __init__(self, code):
+            _FakeGrpcError.__init__(self, code)
+
+    calls = []
+
+    def stub(params):
+        calls.append(1)
+        if len(calls) < 3:
+            raise Boom(grpc.StatusCode.UNAVAILABLE)
+        return pb.PollWorkResult()
+
+    c = _client_with_stub(stub)
+    assert c.poll_work(pb.PollWorkParams()) is not None
+    assert len(calls) == 3
+
+
+def test_rpc_does_not_retry_execution_errors():
+    import grpc
+
+    from ballista_tpu.errors import RpcError
+
+    class Boom(grpc.RpcError, _FakeGrpcError):
+        def __init__(self):
+            _FakeGrpcError.__init__(self, grpc.StatusCode.UNKNOWN, "planner exploded")
+
+    calls = []
+
+    def stub(params):
+        calls.append(1)
+        raise Boom()
+
+    c = _client_with_stub(stub)
+    with pytest.raises(RpcError, match="planner exploded"):
+        c.poll_work(pb.PollWorkParams())
+    assert len(calls) == 1  # surfaced immediately
+
+
+def test_rpc_retry_budget_exhausts():
+    import grpc
+
+    from ballista_tpu.errors import RpcError
+
+    class Boom(grpc.RpcError, _FakeGrpcError):
+        def __init__(self):
+            _FakeGrpcError.__init__(self, grpc.StatusCode.UNAVAILABLE)
+
+    calls = []
+
+    def stub(params):
+        calls.append(1)
+        raise Boom()
+
+    c = _client_with_stub(stub, retries=2)
+    with pytest.raises(RpcError):
+        c.poll_work(pb.PollWorkParams())
+    assert len(calls) == 3  # 1 + 2 retries
+
+
+def test_get_file_metadata_honors_throttle_hint():
+    """Satellite: the scheduler's fail-fast 'too many concurrent metadata
+    requests; retry' response is retried with backoff, not surfaced."""
+    import grpc
+
+    class Boom(grpc.RpcError, _FakeGrpcError):
+        def __init__(self):
+            _FakeGrpcError.__init__(
+                self, grpc.StatusCode.UNKNOWN,
+                "Exception calling application: GetFileMetadata: too many "
+                "concurrent metadata requests; retry",
+            )
+
+    calls = []
+
+    def stub(params):
+        calls.append(1)
+        if len(calls) < 3:
+            raise Boom()
+        return pb.GetFileMetadataResult(num_partitions=7)
+
+    c = _client_with_stub(stub)
+    out = c.get_file_metadata(pb.GetFileMetadataParams(path="x", file_type="parquet"))
+    assert out.num_partitions == 7 and len(calls) == 3
+
+
+# -- poll-loop slot handling (ISSUE 5 satellite: TOCTOU fix) ----------------
+
+class _FakeScheduler:
+    def __init__(self, tasks=None):
+        self.tasks = list(tasks or [])
+        self.polls = []
+
+    def poll_work(self, params):
+        self.polls.append(params)
+        result = pb.PollWorkResult()
+        if params.can_accept_task and self.tasks:
+            result.task.CopyFrom(self.tasks.pop(0))
+        return result
+
+
+def _poll_loop(scheduler, tmp_path, concurrent_tasks=1):
+    from ballista_tpu.executor.execution_loop import PollLoop
+
+    meta = pb.ExecutorMetadata(id="e-test", host="h", port=1)
+    return PollLoop(scheduler, meta, str(tmp_path),
+                    concurrent_tasks=concurrent_tasks)
+
+
+def test_poll_once_never_blocks_when_slots_are_full(tmp_path):
+    """The TOCTOU fix: with every slot taken, poll_once must report
+    can_accept_task=False and return immediately — the old probe/release +
+    blocking re-acquire could hang the heartbeat thread here."""
+    sched = _FakeScheduler()
+    loop = _poll_loop(sched, tmp_path, concurrent_tasks=1)
+    assert loop._available.acquire(blocking=False)  # occupy the only slot
+    done = []
+
+    def poller():
+        loop.poll_once()
+        done.append(True)
+
+    import threading
+
+    t = threading.Thread(target=poller, daemon=True)
+    t.start()
+    t.join(timeout=2.0)
+    assert done, "poll_once blocked with all slots taken (heartbeat stall)"
+    assert sched.polls[-1].can_accept_task is False
+
+
+def test_poll_once_hands_held_slot_to_the_task(tmp_path):
+    """The slot acquired by the probe is the SAME one the task runs under:
+    after receiving a task, no slot remains (concurrent_tasks=1) and the
+    next poll advertises can_accept_task=False until the task finishes."""
+    task = pb.TaskDefinition()
+    task.task_id.job_id = "j"
+    task.task_id.stage_id = 1
+    sched = _FakeScheduler(tasks=[task])
+    loop = _poll_loop(sched, tmp_path, concurrent_tasks=1)
+    gate = __import__("threading").Event()
+
+    def fake_run(task, slot_held=True):
+        gate.wait(5)
+        loop._available.release()
+
+    loop._run_task = fake_run
+    assert loop.poll_once() is True
+    assert sched.polls[-1].can_accept_task is True
+    # slot is held by the (gated) task thread now, and the in-flight task
+    # is echoed so the scheduler can reconcile lost assignments
+    loop.poll_once()
+    assert sched.polls[-1].can_accept_task is False
+    assert [p.job_id for p in sched.polls[-1].running_tasks] == ["j"]
+    gate.set()
+
+
+def test_poll_failure_requeues_drained_statuses(tmp_path):
+    """Statuses drained into a failing poll must survive to the next poll —
+    losing them would wedge their job forever."""
+
+    class FailingScheduler:
+        def poll_work(self, params):
+            raise RuntimeError("scheduler unreachable")
+
+    loop = _poll_loop(FailingScheduler(), tmp_path)
+    st = pb.TaskStatus()
+    st.partition_id.job_id = "j"
+    st.completed.executor_id = "e-test"
+    loop._finished.put(st)
+    with pytest.raises(RuntimeError):
+        loop.poll_once()
+    assert loop._finished.qsize() == 1  # requeued, not lost
+
+
+# -- end-to-end lineage recovery (ISSUE 5 acceptance) -----------------------
+
+def test_end_to_end_recovery_after_executor_death_with_lost_outputs(sales_table):
+    """Executor killed AFTER its map stage completed: outputs lost while
+    downstream reduces run. The job must still complete on the survivor via
+    lineage recomputation (fetch_failed -> map recompute, lost-task resets),
+    with nonzero recovery counters in the new bench fields."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import recovery_stats
+    from ballista_tpu.serde.logical import plan_to_proto
+    import ballista_tpu.scheduler.state as state_mod
+
+    cluster = StandaloneCluster(n_executors=2)
+    old_lease = state_mod.EXECUTOR_LEASE_SECS
+    state_mod.EXECUTOR_LEASE_SECS = 1.0
+    cluster.scheduler_impl.lost_task_check_interval = 0.3
+    recovery_stats(reset=True)
+    try:
+        ctx = BallistaContext(*cluster.scheduler_addr)
+        ctx.register_record_batches("sales", sales_table, n_partitions=4)
+        df = ctx.sql(
+            "select region, sum(amount) as s from sales group by region order by region"
+        )
+        plan = df.logical_plan()
+        params = pb.ExecuteQueryParams()
+        params.logical_plan.CopyFrom(plan_to_proto(plan))
+        for k, v in ctx.config.explicit_settings().items():
+            params.settings.add(key=k, value=v)
+        job_id = ctx._client.execute_query(params).job_id
+
+        # wait for the FIRST stage (the maps) to fully complete
+        state = cluster.scheduler_impl.state
+        deadline = time.time() + 60
+        stage1 = []
+        while time.time() < deadline:
+            tasks = state.get_job_tasks(job_id)
+            if tasks:
+                first = min(t.partition_id.stage_id for t in tasks)
+                stage1 = [t for t in tasks if t.partition_id.stage_id == first]
+                if stage1 and all(
+                    t.WhichOneof("status") == "completed" for t in stage1
+                ):
+                    break
+            time.sleep(0.02)
+        else:
+            pytest.fail("map stage did not complete in time")
+
+        # kill an executor that holds completed map outputs — TOTALLY
+        # (heartbeat AND data plane), so its outputs really are unreachable
+        owners = {t.completed.executor_id for t in stage1}
+        victim = next(ex for ex in cluster.executors if ex.id in owners)
+        victim.stop()
+
+        status = ctx._wait_for_job(job_id, timeout=120.0)
+        tables = [
+            ctx._fetch_partition(loc)
+            for loc in status.completed.partition_location
+        ]
+        out = pa.concat_tables(tables).cast(plan.schema())
+        assert out.column("s").to_pylist() == [120.0, 40.0, 145.0]
+
+        stats = recovery_stats()
+        recovered = (
+            stats.get("fetch_failed", 0)
+            + stats.get("map_recomputed", 0)
+            + stats.get("lost_task_reset", 0)
+            + stats.get("downstream_invalidated", 0)
+        )
+        assert recovered > 0, f"no recovery events recorded: {stats}"
+        assert stats.get("task_retry", 0) > 0, stats
         ctx.close()
     finally:
         state_mod.EXECUTOR_LEASE_SECS = old_lease

@@ -169,13 +169,32 @@ class TestSynchronizeJobStatus:
         assert s.get_job_metadata("job").WhichOneof("status") == "running"
 
     def test_any_failed_fails_job(self, kv):
+        # with retries DISABLED the reference semantics hold: first task
+        # failure fails the job (retry-enabled folds are pinned in
+        # tests/test_fault_tolerance.py)
+        from ballista_tpu.config import BallistaConfig
+
         s = self._state(kv)
+        s.config = BallistaConfig({"ballista.shuffle.max_task_retries": "0"})
         s.save_task_status(_completed("job", 1, 0))
         s.save_task_status(_failed("job", 1, 1, "disk full"))
         s.synchronize_job_status("job")
         st = s.get_job_metadata("job")
         assert st.WhichOneof("status") == "failed"
         assert "disk full" in st.failed.error
+
+    def test_failed_task_requeues_within_budget(self, kv):
+        # default budget (3): the same failure REQUEUES the task with its
+        # history recorded instead of failing the job
+        s = self._state(kv)
+        s.save_task_status(_completed("job", 1, 0))
+        s.save_task_status(_failed("job", 1, 1, "disk full"))
+        s.synchronize_job_status("job")
+        assert s.get_job_metadata("job").WhichOneof("status") == "running"
+        t = s.get_task_status("job", 1, 1)
+        assert t.WhichOneof("status") is None  # pending again
+        assert t.attempt == 1
+        assert [h.error for h in t.history] == ["disk full"]
 
     def test_all_completed_completes_with_final_stage_locations(self, kv):
         s = self._state(kv)

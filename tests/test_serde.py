@@ -311,11 +311,17 @@ class TestPhysicalRoundtrip:
         )
         self.roundtrip(w)
         r = ShuffleReaderExec(
-            [ShuffleLocation("e1", "h", 50051, "/tmp/x")],
+            [ShuffleLocation("e1", "h", 50051, "/tmp/x",
+                             stage_id=3, map_partition=1)],
             SCHEMA,
             4,
         )
-        self.roundtrip(r)
+        r2 = self.roundtrip(r)
+        # the producing map task's lineage survives the wire: fetch_failed
+        # reports name it so the scheduler can recompute the lost partition
+        loc = r2.locations[0]
+        assert (loc.stage_id, loc.map_partition) == (3, 1)
+        assert (loc.executor_id, loc.host, loc.port) == ("e1", "h", 50051)
         u = UnresolvedShuffleExec(7, SCHEMA, 2)
         self.roundtrip(u)
 

@@ -29,6 +29,7 @@ RULES = [
     "dtype-discipline",
     "guarded-by",
     "decline-discipline",
+    "failure-discipline",
 ]
 
 
@@ -112,6 +113,29 @@ def test_overflow_decline_fixture_pair():
     assert any("return None" in m for m in findings)
     good = analyze_file(str(FIXTURES / "decline_overflow_good.py"))
     assert good == [], "\n".join(f.format() for f in good)
+
+
+def test_failure_rule_flags_all_four_shapes():
+    """ISSUE 5 satellite: anonymous fetch_failed, unregistered site,
+    computed site, ad-hoc ChaosInjected raise."""
+    findings = [
+        f.message for f in analyze_file(str(FIXTURES / "failure_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any("lost location" in m for m in findings)
+    assert any("unregistered chaos site" in m for m in findings)
+    assert any("string literal" in m for m in findings)
+    assert any("ad-hoc" in m and "ChaosInjected" in m for m in findings)
+
+
+def test_failure_rule_sites_track_chaos_registry():
+    """The rule reads SITES from ballista_tpu/utils/chaos.py, so the two
+    can't drift silently."""
+    from ballista_tpu.utils import chaos
+    from dev.analysis.rules_failure import _registered_sites
+
+    assert _registered_sites(str(REPO / "ballista_tpu" / "executor" /
+                                 "execution_loop.py")) == frozenset(chaos.SITES)
 
 
 def test_guarded_rule_checks_holds_lock_callers():
