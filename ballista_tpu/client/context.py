@@ -76,22 +76,62 @@ class BallistaContext(ExecutionContext):
 
     # -- execution ---------------------------------------------------------
     def collect(self, plan: lp.LogicalPlan, timeout: float = 300.0) -> pa.Table:
+        job_id = self.submit(plan)
+        return self._collect_results(job_id, plan.schema(), timeout)
+
+    def submit(self, plan: lp.LogicalPlan) -> str:
+        """ExecuteQuery only: returns the job id without waiting for (or
+        fetching) results — collect() is submit + _collect_results."""
         params = pb.ExecuteQueryParams()
         params.logical_plan.CopyFrom(plan_to_proto(plan))
         # only non-default settings travel: they override scheduler/executor
         # configs per job without clobbering host-local tuning
         for k, v in self.config.explicit_settings().items():
             params.settings.add(key=k, value=v)
-        job_id = self._client.execute_query(params).job_id
-        status = self._wait_for_job(job_id, timeout)
-        tables = []
-        schema = plan.schema()
-        for loc in status.completed.partition_location:
-            t = self._fetch_partition(loc)
-            tables.append(t)
-        if not tables:
-            return schema.empty_table()
-        return pa.concat_tables(tables).cast(schema)
+        return self._client.execute_query(params).job_id
+
+    def _collect_results(
+        self, job_id: str, schema, timeout: float = 300.0
+    ) -> pa.Table:
+        """Wait for the job, then fetch each result partition from the
+        executor holding it. A fetch failure against the now-TERMINAL job
+        (the owner died between completion and this fetch — the scheduler's
+        lost-task machinery skips finished jobs, so nobody else notices)
+        is reported back via ReportLostPartition: the scheduler requeues
+        the lost final-stage tasks through lineage and flips the job back
+        to running, and this loop re-polls for the fresh locations instead
+        of erroring (ISSUE 6 / PR 5 residue)."""
+        from ballista_tpu.errors import ShuffleFetchError
+
+        deadline = time.time() + timeout
+        while True:
+            status = self._wait_for_job(job_id, max(0.0, deadline - time.time()))
+            try:
+                tables = [
+                    self._fetch_partition(loc)
+                    for loc in status.completed.partition_location
+                ]
+            except ShuffleFetchError as e:
+                result = self._client.report_lost_partition(
+                    pb.ReportLostPartitionParams(
+                        job_id=job_id,
+                        executor_id=e.executor_id,
+                        stage_id=e.stage_id,
+                        partition_id=e.map_partition,
+                        path=e.path,
+                    )
+                )
+                if not result.restarted:
+                    # nothing for the scheduler to restart (or the job
+                    # already failed for good): surface the fetch error
+                    raise
+                from ballista_tpu.ops.runtime import record_recovery
+
+                record_recovery("result_fetch_restarted")
+                continue
+            if not tables:
+                return schema.empty_table()
+            return pa.concat_tables(tables).cast(schema)
 
     def _wait_for_job(self, job_id: str, timeout: float) -> pb.JobStatus:
         deadline = time.time() + timeout
@@ -108,16 +148,40 @@ class BallistaContext(ExecutionContext):
 
     def _fetch_partition(self, loc: pb.PartitionLocation) -> pa.Table:
         from ballista_tpu.client.flight import BallistaClient
+        from ballista_tpu.errors import RpcError, ShuffleFetchError
 
-        client = BallistaClient(
-            loc.executor_meta.host,
-            loc.executor_meta.port,
-            retries=self.config.rpc_retries(),
-            backoff_s=self.config.rpc_backoff_s(),
-        )
+        try:
+            client = BallistaClient(
+                loc.executor_meta.host,
+                loc.executor_meta.port,
+                retries=self.config.rpc_retries(),
+                backoff_s=self.config.rpc_backoff_s(),
+            )
+        except Exception as e:  # connect failure = same lost location
+            raise ShuffleFetchError(
+                f"result partition unreachable: {e}",
+                executor_id=loc.executor_meta.id,
+                host=loc.executor_meta.host,
+                port=loc.executor_meta.port,
+                path=loc.path,
+                stage_id=loc.partition_id.stage_id,
+                map_partition=loc.partition_id.partition_id,
+            ) from e
         try:
             # the final stage writes piece 0 per input partition
             return client.fetch_partition(os.path.join(loc.path, "0.arrow"))
+        except RpcError as e:
+            # name the lost location so _collect_results can report it to
+            # the scheduler (ReportLostPartition) instead of just erroring
+            raise ShuffleFetchError(
+                f"result partition fetch failed: {e}",
+                executor_id=loc.executor_meta.id,
+                host=loc.executor_meta.host,
+                port=loc.executor_meta.port,
+                path=loc.path,
+                stage_id=loc.partition_id.stage_id,
+                map_partition=loc.partition_id.partition_id,
+            ) from e
         finally:
             client.close()
 

@@ -74,9 +74,11 @@ class PollLoop:
         # tasks currently executing here, echoed in every poll so the
         # scheduler can reconcile assignments whose response never reached
         # us (lost-in-transit PollWork replies would otherwise orphan the
-        # task in Running forever)
+        # task in Running forever). The echo carries the ATTEMPT so a
+        # restarted scheduler's ledger re-adoption never accepts a stale
+        # attempt's vouch (ISSUE 6).
         self._inflight_mu = threading.Lock()
-        self._inflight: dict = {}  # (job, stage, part) -> PartitionId; guarded-by: self._inflight_mu
+        self._inflight: dict = {}  # (job, stage, part) -> (PartitionId, attempt); guarded-by: self._inflight_mu
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -179,8 +181,14 @@ class PollLoop:
             params = pb.PollWorkParams(
                 metadata=self.metadata, can_accept_task=slot_held
             )
-            for pid in inflight:
+            for pid, attempt in inflight:
+                # both echo forms: running_tasks for wire compat with
+                # pre-ISSUE-6 schedulers, running_echo (attempt-enriched)
+                # for precise ledger reconciliation
                 params.running_tasks.add().CopyFrom(pid)
+                e = params.running_echo.add()
+                e.partition_id.CopyFrom(pid)
+                e.attempt = attempt
             for st in statuses:
                 params.task_status.add().CopyFrom(st)
             result = self.scheduler.poll_work(params)
@@ -196,7 +204,9 @@ class PollLoop:
         if result.HasField("task"):
             pid = result.task.task_id
             with self._inflight_mu:
-                self._inflight[(pid.job_id, pid.stage_id, pid.partition_id)] = pid
+                self._inflight[(pid.job_id, pid.stage_id, pid.partition_id)] = (
+                    pid, result.task.attempt,
+                )
             # slot ownership transfers to the task thread (released in
             # _run_task's finally). A task arriving WITHOUT a held slot
             # (scheduler ignored can_accept_task=False) must not be

@@ -102,7 +102,8 @@ class StandaloneCluster:
         concurrent_tasks: int = 4,
     ) -> None:
         self.config = config or BallistaConfig()
-        self.scheduler_impl = SchedulerServer(kv or MemoryBackend(), config=self.config)
+        self.kv = kv or MemoryBackend()
+        self.scheduler_impl = SchedulerServer(self.kv, config=self.config)
         self.port = _free_port()
         self.grpc_server = serve(self.scheduler_impl, "127.0.0.1", self.port)
         self.executors: List[BallistaExecutor] = []
@@ -122,6 +123,29 @@ class StandaloneCluster:
     @property
     def scheduler_addr(self) -> Tuple[str, int]:
         return ("127.0.0.1", self.port)
+
+    def restart_scheduler(self) -> SchedulerServer:
+        """Simulate scheduler process death + restart on the same KV store
+        (ISSUE 6): stop the gRPC server, build a FRESH SchedulerServer over
+        the same backend (its __init__ runs restart recovery — torn-job
+        sweep + durable-ledger reload), and serve again on the same port so
+        executors and clients ride their transient-UNAVAILABLE retry loops
+        across the gap. All in-memory scheduler state (task index, ledger
+        timestamps, planning threads) dies with the old instance — exactly
+        what a real restart loses."""
+        old = self.scheduler_impl
+        # fence the old instance FIRST: its still-running planning threads
+        # must not publish into the store the successor is recovering
+        old.crashed = True
+        # wait for the listening socket to actually close before rebinding
+        # the same port (so_reuseport is not guaranteed everywhere)
+        self.grpc_server.stop(grace=None).wait()
+        self.scheduler_impl = SchedulerServer(self.kv, config=self.config)
+        # test harness tuning survives the restart (a redeployed scheduler
+        # keeps its deployment config)
+        self.scheduler_impl.lost_task_check_interval = old.lost_task_check_interval
+        self.grpc_server = serve(self.scheduler_impl, "127.0.0.1", self.port)
+        return self.scheduler_impl
 
     def shutdown(self) -> None:
         for ex in self.executors:

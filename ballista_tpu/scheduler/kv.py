@@ -35,6 +35,19 @@ class KvBackend:
     def put(self, key: str, value: bytes, lease_seconds: Optional[float] = None) -> None:
         raise NotImplementedError
 
+    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
+        """Atomic multi-put: either every (key, value) lands or none does —
+        the crash-safe publish seam for multi-key writes (a job's planning
+        output must never be half-visible, ISSUE 6). Backends without real
+        transactions must still make the batch all-or-nothing under the
+        global lock."""
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        """Delete exactly `key`. NOT delete_prefix(key): ledger keys like
+        assignments/j/1/2 are string prefixes of assignments/j/1/20."""
+        raise NotImplementedError
+
     def delete_prefix(self, prefix: str) -> None:
         raise NotImplementedError
 
@@ -78,6 +91,17 @@ class MemoryBackend(KvBackend):
         with self._mu:
             expires = time.time() + lease_seconds if lease_seconds else None
             self._data[key] = (value, expires)
+
+    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
+        # validate the whole batch before touching the dict so a bad item
+        # cannot leave a partial write behind
+        staged = [(k, (v, None)) for k, v in items]
+        with self._mu:
+            self._data.update(staged)
+
+    def delete(self, key: str) -> None:
+        with self._mu:
+            self._data.pop(key, None)
 
     def delete_prefix(self, prefix: str) -> None:
         with self._mu:
@@ -158,6 +182,27 @@ class SqliteBackend(KvBackend):
             )
             self._conn.commit()
 
+    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
+        # one sqlite transaction: a crash (or a bad item) mid-batch rolls
+        # the whole publish back — this is the backend-transaction form of
+        # the ISSUE 6 all-or-nothing planning write
+        with self._mu:
+            try:
+                self._conn.executemany(
+                    "INSERT OR REPLACE INTO kv (key, value, expires) "
+                    "VALUES (?, ?, NULL)",
+                    items,
+                )
+                self._conn.commit()
+            except BaseException:
+                self._conn.rollback()
+                raise
+
+    def delete(self, key: str) -> None:
+        with self._mu:
+            self._conn.execute("DELETE FROM kv WHERE key = ?", (key,))
+            self._conn.commit()
+
     def delete_prefix(self, prefix: str) -> None:
         with self._mu:
             self._conn.execute(
@@ -210,6 +255,30 @@ class EtcdBackend(KvBackend):
             else None
         )
         self._client.put(key, value, lease=lease)
+
+    # etcd rejects transactions above --max-txn-ops (default 128); a plan
+    # batch beyond it cannot be published atomically on a default server
+    MAX_TXN_OPS = 128
+
+    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
+        # etcd v3 transaction: success branch only, no compares — an
+        # unconditional atomic multi-put
+        if len(items) > self.MAX_TXN_OPS:
+            # fail LOUDLY instead of letting the server reject with an
+            # opaque error (or silently splitting and losing atomicity):
+            # the deployment must raise --max-txn-ops to plan jobs with
+            # this many stages x partitions
+            raise RuntimeError(
+                f"atomic batch of {len(items)} keys exceeds etcd's default "
+                f"max-txn-ops ({self.MAX_TXN_OPS}); raise --max-txn-ops on "
+                "the etcd server (and MAX_TXN_OPS here) or reduce "
+                "ballista.shuffle.partitions"
+            )
+        ops = [self._client.transactions.put(k, v) for k, v in items]
+        self._client.transaction(compare=[], success=ops, failure=[])
+
+    def delete(self, key: str) -> None:
+        self._client.delete(key)
 
     def delete_prefix(self, prefix: str) -> None:
         self._client.delete_prefix(prefix)

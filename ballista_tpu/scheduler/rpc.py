@@ -34,6 +34,10 @@ _METHODS = {
     "GetJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
     "GetExecutorsMetadata": (pb.GetExecutorMetadataParams, pb.GetExecutorMetadataResult),
     "GetFileMetadata": (pb.GetFileMetadataParams, pb.GetFileMetadataResult),
+    "ReportLostPartition": (
+        pb.ReportLostPartitionParams,
+        pb.ReportLostPartitionResult,
+    ),
 }
 
 
@@ -155,6 +159,11 @@ class SchedulerGrpcClient:
 
     def get_executors_metadata(self) -> pb.GetExecutorMetadataResult:
         return self._call("GetExecutorsMetadata", pb.GetExecutorMetadataParams())
+
+    def report_lost_partition(
+        self, params: pb.ReportLostPartitionParams
+    ) -> pb.ReportLostPartitionResult:
+        return self._call("ReportLostPartition", params)
 
     def get_file_metadata(self, params: pb.GetFileMetadataParams) -> pb.GetFileMetadataResult:
         """GetFileMetadata with throttle handling: the server sheds load

@@ -54,12 +54,27 @@ class SchedulerServer:
     ) -> None:
         self.config = config or BallistaConfig()
         self.state = SchedulerState(kv or MemoryBackend(), namespace, config=self.config)
+        # restart recovery BEFORE serving: discard torn (uncommitted) jobs,
+        # reload the durable assignment ledger with a fresh grace window
+        # (no-op with zero counters on a fresh store)
+        self.recovery_stats = self.state.recover()
         # catalog for SQL queries arriving as text (CREATE EXTERNAL TABLE
         # statements executed through the scheduler register here)
         self.catalog = ExecutionContext(self.config)
         self.synchronous_planning = synchronous_planning
         self._lock = threading.Lock()
         self._last_lost_check = 0.0
+        # deterministic scheduler-death injection (utils/chaos.py
+        # "scheduler.crash"): keyed on the ACCEPTED-STATUS sequence so the
+        # seeded crash lands mid-job (statuses only exist after planning
+        # committed), regardless of poll interleaving. Once crashed, every
+        # RPC answers UNAVAILABLE — exactly what a dead process looks like
+        # to retrying clients — until the harness restarts the scheduler on
+        # the same KV store (StandaloneCluster.restart_scheduler).
+        self._chaos = self.state._chaos
+        self._accepted_statuses = 0  # under the kv lock (PollWork body)
+        self.crashed = False
+        self.on_crash = None
         # tasks running on executors whose lease lapsed are rescheduled this
         # often (the reference loses such work permanently)
         self.lost_task_check_interval = 5.0
@@ -68,8 +83,38 @@ class SchedulerServer:
         # requests can never starve PollWork heartbeats of workers
         self._file_meta_slots = threading.BoundedSemaphore(4)
 
+    # -- crash simulation ---------------------------------------------------
+    def _refuse_if_crashed(self, context) -> None:
+        """A chaos-crashed scheduler is a dead process: every RPC fails
+        UNAVAILABLE (transient to retrying clients) until the restart."""
+        if not self.crashed:
+            return
+        if context is not None:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE, "scheduler crashed (chaos)"
+            )
+        raise RuntimeError("scheduler crashed (chaos)")
+
+    def _crash(self, context) -> None:
+        from ballista_tpu.ops.runtime import record_recovery
+
+        record_recovery("chaos_injected")
+        record_recovery("chaos_scheduler_crash")
+        log.warning(
+            "chaos[scheduler.crash]: scheduler dying after accepting "
+            "status #%d", self._accepted_statuses,
+        )
+        self.crashed = True
+        if self.on_crash is not None:
+            try:
+                self.on_crash()
+            except Exception as e:
+                log.warning("on_crash hook failed: %s", e)
+        self._refuse_if_crashed(context)
+
     # -- RPC implementations ------------------------------------------------
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None) -> pb.ExecuteQueryResult:
+        self._refuse_if_crashed(context)
         from ballista_tpu.executor.confine import (
             check_proto_scan_roots,
             check_scan_files,
@@ -132,15 +177,51 @@ class SchedulerServer:
         return pb.ExecuteQueryResult(job_id=job_id)
 
     def _plan_job_safe(self, job_id: str, plan, config) -> None:
-        try:
-            self._plan_job(job_id, plan, config)
-        except Exception as e:  # surface planning failure as job failure
-            log.exception("planning job %s failed", job_id)
-            failed = pb.JobStatus()
-            failed.failed.error = f"planning failed: {e}"
-            self.state.save_job_metadata(job_id, failed)
+        from ballista_tpu.ops.runtime import record_recovery
+        from ballista_tpu.utils.chaos import ChaosInjected
 
-    def _plan_job(self, job_id: str, plan, config) -> None:
+        limit = self.state.retry_limit(job_id)
+        attempt = 0
+        while True:
+            if self.crashed:
+                # fence: this planning thread belongs to a crashed (or
+                # restarted-over) scheduler instance. Committing now would
+                # resurrect a job the successor's recover() already failed
+                # as torn — abandon without writing anything
+                log.warning("abandoning planning of job %s: scheduler "
+                            "instance crashed", job_id)
+                return
+            try:
+                self._plan_job(job_id, plan, config, attempt=attempt)
+                return
+            except ChaosInjected as e:
+                # the staged batch died before commit, so NOTHING was
+                # published (atomic publish) — planning retries whole, like
+                # a task attempt, with the chaos key rotated so the seeded
+                # retry draws fresh verdicts
+                attempt += 1
+                if attempt > limit:
+                    log.error("planning job %s failed after %d chaos-torn "
+                              "attempts", job_id, attempt)
+                    failed = pb.JobStatus()
+                    failed.failed.error = (
+                        f"planning failed after {attempt} attempts: {e}"
+                    )
+                    self.state.save_job_metadata(job_id, failed)
+                    return
+                record_recovery("plan_retry")
+                log.warning("planning job %s torn by chaos; retrying "
+                            "(attempt %d)", job_id, attempt)
+            except Exception as e:  # surface planning failure as job failure
+                log.exception("planning job %s failed", job_id)
+                if self.crashed:
+                    return  # successor owns the job's fate now
+                failed = pb.JobStatus()
+                failed.failed.error = f"planning failed: {e}"
+                self.state.save_job_metadata(job_id, failed)
+                return
+
+    def _plan_job(self, job_id: str, plan, config, attempt: int = 0) -> None:
         from ballista_tpu.config import BALLISTA_TPU_COALESCE_AGG
 
         # distributed jobs keep the Partial/exchange/Final shape: the stage
@@ -148,23 +229,28 @@ class SchedulerServer:
         ctx = ExecutionContext(config.with_setting(BALLISTA_TPU_COALESCE_AGG, "false"))
         physical = ctx.create_physical_plan(plan)
         stages = DistributedPlanner(config).plan_query_stages(job_id, physical)
+        # all-or-nothing publish: stage plans, pending tasks, and the
+        # queued->running flip land in ONE KV batch, so a crash mid-plan
+        # leaves no torn job (the job stays queued with no planning keys
+        # and recover() fails it cleanly on restart)
+        batch = self.state.stage_job_plan(job_id, attempt)
         for stage in stages:
-            self.state.save_stage_plan(job_id, stage.stage_id, stage)
+            batch.add_stage_plan(stage.stage_id, stage)
             n = stage.output_partitioning().partition_count()
             for p in range(n):
-                pending = pb.TaskStatus()
-                pending.partition_id.job_id = job_id
-                pending.partition_id.stage_id = stage.stage_id
-                pending.partition_id.partition_id = p
-                self.state.save_task_status(pending)
-        running = pb.JobStatus()
-        running.running.SetInParent()
-        self.state.save_job_metadata(job_id, running)
+                batch.add_pending_task(stage.stage_id, p)
+        if self.crashed:
+            # last fence before the publish (narrow in-process race left:
+            # real restarts are separate processes where the dead
+            # scheduler's threads cannot write at all)
+            raise RuntimeError("scheduler crashed during planning")
+        batch.commit()
         log.info("job %s planned into %d stages", job_id, len(stages))
 
     def PollWork(self, request: pb.PollWorkParams, context=None) -> pb.PollWorkResult:
         import time as _time
 
+        self._refuse_if_crashed(context)
         with self.state.kv.lock():
             self.state.save_executor_metadata(request.metadata)
             now = _time.time()
@@ -179,11 +265,29 @@ class SchedulerServer:
                 # accepted ones keep the KV-side attempt history
                 if self.state.accept_task_status(ts):
                     jobs.add(ts.partition_id.job_id)
+                    self._accepted_statuses += 1
+                    # generation-rotated key: a restarted scheduler must
+                    # draw fresh verdicts, not re-crash at the same status
+                    if self._chaos is not None and self._chaos.should_inject(
+                        "scheduler.crash",
+                        f"g{self.state.generation}"
+                        f"/status{self._accepted_statuses}",
+                    ):
+                        # accepted writes up to HERE are durable; the rest
+                        # of this poll's statuses are requeued by the
+                        # executor and re-delivered to the restarted
+                        # scheduler (accept_task_status is idempotent)
+                        self._crash(context)
             # after statuses (a completed report must clear its assignment
-            # first): requeue assignments this executor never received
-            n = self.state.reconcile_running_tasks(
-                request.metadata.id, request.running_tasks
+            # first): requeue assignments this executor never received.
+            # Prefer the attempt-enriched echo; fall back to the bare
+            # PartitionId form for pre-ISSUE-6 executors
+            echo = (
+                request.running_echo
+                if len(request.running_echo)
+                else request.running_tasks
             )
+            n = self.state.reconcile_running_tasks(request.metadata.id, echo)
             if n:
                 log.warning(
                     "requeued %d orphaned assignment(s) for executor %s",
@@ -208,19 +312,52 @@ class SchedulerServer:
             return result
 
     def GetJobStatus(self, request: pb.GetJobStatusParams, context=None) -> pb.GetJobStatusResult:
+        self._refuse_if_crashed(context)
         status = self.state.get_job_metadata(request.job_id)
         result = pb.GetJobStatusResult()
         if status is not None:
             result.status.CopyFrom(status)
         return result
 
+    def ReportLostPartition(
+        self, request: pb.ReportLostPartitionParams, context=None
+    ) -> pb.ReportLostPartitionResult:
+        """A client's result fetch failed against a COMPLETED job: restart
+        the final-stage tasks that died with the named executor through the
+        lineage/retry machinery (scheduler/state.py::restart_completed_job).
+        Declined (restarted=False) when the job is not completed or nothing
+        completed on that executor — the client re-raises its fetch error."""
+        self._refuse_if_crashed(context)
+        with self.state.kv.lock():
+            n = self.state.restart_completed_job(
+                request.job_id, request.executor_id
+            )
+            restarted = n > 0
+            if n == 0:
+                # concurrent-reporter race: another client's report already
+                # flipped the job back to running. Tell this client to keep
+                # polling (restarted=True) instead of re-raising its fetch
+                # error while recovery is in flight.
+                js = self.state.get_job_metadata(request.job_id)
+                restarted = (
+                    js is not None and js.WhichOneof("status") == "running"
+                )
+        log.warning(
+            "ReportLostPartition(job=%s, executor=%s, %s/%s): restarted %d",
+            request.job_id, request.executor_id,
+            request.stage_id, request.partition_id, n,
+        )
+        return pb.ReportLostPartitionResult(restarted=restarted, tasks_restarted=n)
+
     def GetExecutorsMetadata(self, request, context=None) -> pb.GetExecutorMetadataResult:
+        self._refuse_if_crashed(context)
         result = pb.GetExecutorMetadataResult()
         for m in self.state.get_executors_metadata():
             result.metadata.add().CopyFrom(m)
         return result
 
     def GetFileMetadata(self, request: pb.GetFileMetadataParams, context=None) -> pb.GetFileMetadataResult:
+        self._refuse_if_crashed(context)
         # parquet only, like the reference (lib.rs:184-222)
         if request.file_type.lower() != "parquet":
             raise ValueError("GetFileMetadata supports parquet only")

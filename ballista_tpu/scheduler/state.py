@@ -7,8 +7,20 @@ backend resumes mid-job. Key layout (ref state/mod.rs:387-434):
 
     executors/{id}                  ExecutorMetadata (60s lease)
     jobs/{job_id}                   JobStatus
+    settings/{job_id}               JobSettings (client per-job settings)
     stages/{job_id}/{stage_id}      PhysicalPlanNode (the stage plan)
     tasks/{job_id}/{stage_id}/{p}   TaskStatus (empty oneof = pending)
+    assignments/{job_id}/{stage}/{p} Assignment (durable in-flight ledger)
+    meta/restart_generation         int (bumped by each restart recovery)
+
+Crash tolerance (ISSUE 6): planning writes publish atomically through
+KvBackend.put_all (the `running` job status is the commit marker — a job
+still `queued` after a scheduler crash was never committed), the
+assignment ledger is written through to the KV so a restarted scheduler
+reloads it, and `recover()` folds the reloaded ledger against executors'
+PollWork `running_echo` — tasks the owner still runs are re-adopted,
+tasks nobody vouches for within the grace window requeue through the
+normal retry/lineage path.
 """
 
 from __future__ import annotations
@@ -130,6 +142,75 @@ class _TaskIndex:
 TASK_INDEX_RESEED_SECS = 5.0
 
 
+class JobPlanBatch:
+    """One job's planning output, published all-or-nothing (ISSUE 6).
+
+    Job submission used to write job metadata, per-stage plans, and task
+    statuses as independent puts — a scheduler crash mid-plan left a torn
+    job (some stages visible, some tasks missing, status forever queued).
+    The batch stages every planning write in memory and commits them in a
+    single KvBackend.put_all TOGETHER WITH the `running` job-status flip,
+    which is therefore the commit marker: a job still `queued` after a
+    crash provably has no planning keys (transactional backends roll the
+    batch back; recover() discards leakage from non-transactional ones).
+
+    Every staged write passes the `scheduler.plan_write` chaos site, keyed
+    on PLAN coordinates + the planning attempt (never the random job id),
+    so a seeded chaos run tears planning at the same point every run and a
+    planning retry draws fresh verdicts."""
+
+    def __init__(self, state: "SchedulerState", job_id: str, attempt: int = 0) -> None:
+        self._state = state
+        self.job_id = job_id
+        self.attempt = attempt
+        self._items: List[Tuple[str, bytes]] = []
+        self._tasks: List[pb.TaskStatus] = []
+
+    def _chaos(self, key: str) -> None:
+        if self._state._chaos is not None:
+            self._state._chaos.maybe_fail(
+                "scheduler.plan_write", f"{key}@a{self.attempt}"
+            )
+
+    def add_stage_plan(self, stage_id: int, plan) -> None:
+        self._chaos(f"stage{stage_id}")
+        msg = phys_plan_to_proto(plan)
+        self._items.append((
+            self._state._key("stages", self.job_id, str(stage_id)),
+            msg.SerializeToString(),
+        ))
+
+    def add_pending_task(self, stage_id: int, partition: int) -> None:
+        self._chaos(f"{stage_id}/{partition}")
+        pending = pb.TaskStatus()
+        pending.partition_id.job_id = self.job_id
+        pending.partition_id.stage_id = stage_id
+        pending.partition_id.partition_id = partition
+        self._items.append((
+            self._state._key(
+                "tasks", self.job_id, str(stage_id), str(partition)
+            ),
+            pending.SerializeToString(),
+        ))
+        self._tasks.append(pending)
+
+    def commit(self) -> None:
+        """Publish the whole plan + the queued->running flip atomically."""
+        self._chaos("commit")
+        running = pb.JobStatus()
+        running.running.SetInParent()
+        items = self._items + [(
+            self._state._key("jobs", self.job_id),
+            running.SerializeToString(),
+        )]
+        self._state.kv.put_all(items)
+        # index only AFTER the publish succeeded: an aborted batch must
+        # leave no trace, in the index included
+        if self._state._task_index is not None:
+            for t in self._tasks:
+                self._state._task_index.observe(t)
+
+
 class SchedulerState:
     def __init__(
         self,
@@ -147,20 +228,129 @@ class SchedulerState:
 
         self._chaos = chaos_from_config(self.config)
         self._chaos_puts = 0  # kv.put key rotation; under the kv lock
-        # in-memory assignment ledger: (job, stage, part) -> (executor,
-        # attempt, monotonic time). PollWork is retried on UNAVAILABLE and
-        # is NOT idempotent: if the response carrying an assignment is lost,
-        # the task sits Running on a live-lease executor that never heard of
-        # it. Executors echo their in-flight tasks each poll;
-        # reconcile_running_tasks requeues ledger entries the owner stopped
-        # vouching for. Single-scheduler in-memory state (a restarted
-        # scheduler re-orphans nothing — its entries are gone — so those
-        # tasks wait for the executor lease machinery instead). All access
-        # happens under the scheduler's global KV lock held by PollWork.
-        self._assigned: Dict[Tuple[str, int, int], Tuple[str, int, float]] = {}
+        # assignment ledger: (job, stage, part) -> (executor, attempt,
+        # monotonic time, restored-by-restart). PollWork is retried on
+        # UNAVAILABLE and is NOT idempotent: if the response carrying an
+        # assignment is lost, the task sits Running on a live-lease executor
+        # that never heard of it. Executors echo their in-flight tasks each
+        # poll; reconcile_running_tasks requeues ledger entries the owner
+        # stopped vouching for. Every mutation is WRITTEN THROUGH to the KV
+        # under assignments/{job}/{stage}/{part} (pb.Assignment, keyed by
+        # plan coordinates so replays are idempotent) — recover() reloads it
+        # after a scheduler restart with a fresh grace window, so restart
+        # reconciliation re-adopts tasks executors still run instead of
+        # waiting for the lease machinery. The in-memory map carries the
+        # monotonic timestamp (wall clock is not restart-comparable). All
+        # access happens under the scheduler's global KV lock held by
+        # PollWork.
+        self._assigned: Dict[
+            Tuple[str, int, int], Tuple[str, int, float, bool]
+        ] = {}
+        # how many restart recoveries this store has seen (0 = first life).
+        # Chaos keys that are per-process sequences (scheduler.crash) fold
+        # the generation in, so a restarted scheduler draws FRESH verdicts
+        # instead of deterministically re-crashing at the same point.
+        self.generation = 0
 
     def _key(self, *parts: str) -> str:
         return "/".join(("/ballista", self.namespace) + parts)
+
+    # -- durable assignment ledger ------------------------------------------
+    def _ledger_key(self, key: Tuple[str, int, int]) -> str:
+        job_id, stage_id, partition = key
+        return self._key("assignments", job_id, str(stage_id), str(partition))
+
+    def _ledger_put(
+        self, key: Tuple[str, int, int], executor_id: str, attempt: int
+    ) -> None:
+        """Record an in-flight assignment, write-through: memory carries the
+        monotonic grace-window clock, the KV carries the restart truth."""
+        self._assigned[key] = (executor_id, attempt, time.monotonic(), False)
+        msg = pb.Assignment(executor_id=executor_id, attempt=attempt)
+        self.kv.put(self._ledger_key(key), msg.SerializeToString())
+
+    def _ledger_del(self, key: Tuple[str, int, int]) -> None:
+        self._assigned.pop(key, None)
+        self.kv.delete(self._ledger_key(key))
+
+    def recover(self) -> Dict[str, int]:
+        """Scheduler-restart recovery: called once before serving (the
+        caller holds no lock yet — nothing else can touch this state).
+
+        - A job still QUEUED was never committed: planning publishes stages,
+          tasks, and the `running` flip in ONE atomic put_all, and the
+          logical plan lived only in the dead scheduler's memory — so the
+          job is failed cleanly ("resubmit") instead of hanging the client
+          forever, and any stray keys a non-transactional backend might
+          have leaked are discarded. NOTE: on a SHARED (multi-scheduler)
+          namespace this would fail a peer's in-flight planning; restart
+          recovery assumes the single-scheduler deployments this repo runs.
+        - RUNNING jobs resume as-is (tasks/stages/settings are already in
+          the KV; the task index reseeds from a scan).
+        - The assignment ledger reloads with a FRESH grace window: entries
+          whose KV task status no longer matches (resolved or superseded
+          before the crash) are dropped; the rest wait for their owner's
+          running_echo — re-adopted on the first vouching poll, requeued
+          through the normal retry path if nobody vouches in time.
+
+        Returns the recovery counters (also fed into ops.runtime so
+        bench.py's `recovery` field picks them up). A fresh store returns
+        {} without recording anything."""
+        jobs = list(self.kv.get_prefix(self._key("jobs")))
+        ledger = list(self.kv.get_prefix(self._key("assignments")))
+        if not jobs and not ledger:
+            return {}
+        stats: Dict[str, int] = {}
+
+        def bump(event: str) -> None:
+            _record_recovery(event)
+            stats[event] = stats.get(event, 0) + 1
+
+        bump("scheduler_restart")
+        gen_key = self._key("meta", "restart_generation")
+        prior = self.kv.get(gen_key)
+        self.generation = (int(prior) if prior else 0) + 1
+        self.kv.put(gen_key, str(self.generation).encode())
+        for k, v in jobs:
+            job_id = k.rsplit("/", 1)[1]
+            js = pb.JobStatus()
+            js.ParseFromString(v)
+            w = js.WhichOneof("status")
+            if w == "queued":
+                failed = pb.JobStatus()
+                failed.failed.error = (
+                    "scheduler restarted before planning committed; the job "
+                    "was never submitted to executors — resubmit it"
+                )
+                self.save_job_metadata(job_id, failed)
+                self.kv.delete(self._key("settings", job_id))
+                self.kv.delete_prefix(self._key("stages", job_id) + "/")
+                self.kv.delete_prefix(self._key("tasks", job_id) + "/")
+                bump("torn_job_discarded")
+                log.warning("discarded torn (uncommitted) job %s", job_id)
+            elif w == "running":
+                bump("restart_job_resumed")
+        now = time.monotonic()
+        for k, v in ledger:
+            tail = k.rsplit("/", 3)
+            key = (tail[1], int(tail[2]), int(tail[3]))
+            a = pb.Assignment()
+            a.ParseFromString(v)
+            cur = self.get_task_status(*key)
+            if (
+                cur is None
+                or cur.WhichOneof("status") != "running"
+                or cur.attempt != a.attempt
+                or cur.running.executor_id != a.executor_id
+            ):
+                # resolved or superseded before the crash; drop the entry
+                self.kv.delete(k)
+                continue
+            self._assigned[key] = (a.executor_id, a.attempt, now, True)
+            bump("restart_assignment_restored")
+        if stats:
+            log.warning("scheduler restart recovery: %s", stats)
+        return stats
 
     # -- executors ----------------------------------------------------------
     def save_executor_metadata(self, meta: pb.ExecutorMetadata) -> None:
@@ -215,6 +405,10 @@ class SchedulerState:
         return {kv.key: kv.value for kv in msg.settings}
 
     # -- stage plans ----------------------------------------------------------
+    def stage_job_plan(self, job_id: str, attempt: int = 0) -> JobPlanBatch:
+        """Start an atomic planning publish for job_id (see JobPlanBatch)."""
+        return JobPlanBatch(self, job_id, attempt)
+
     def save_stage_plan(self, job_id: str, stage_id: int, plan) -> None:
         msg = phys_plan_to_proto(plan)
         self.kv.put(
@@ -270,9 +464,7 @@ class SchedulerState:
         self.save_task_status(merged)
         if merged.WhichOneof("status") in ("completed", "failed", "fetch_failed"):
             # the assignment resolved; stop watching for orphaning
-            self._assigned.pop(
-                (pid.job_id, pid.stage_id, pid.partition_id), None
-            )
+            self._ledger_del((pid.job_id, pid.stage_id, pid.partition_id))
         return True
 
     def _ensure_task_index(self) -> _TaskIndex:
@@ -350,6 +542,11 @@ class SchedulerState:
         job with the full history instead."""
         if t.attempt >= limit:
             return False
+        pid0 = t.partition_id
+        # any in-flight assignment of the superseded attempt is now stale;
+        # clearing it here keeps the durable ledger from carrying entries a
+        # restarted scheduler would have to re-validate and discard
+        self._ledger_del((pid0.job_id, pid0.stage_id, pid0.partition_id))
         pending = pb.TaskStatus()
         pending.partition_id.CopyFrom(t.partition_id)
         pending.attempt = t.attempt + 1
@@ -522,6 +719,60 @@ class SchedulerState:
             # with the full lineage in the error
         return True
 
+    def restart_completed_job(self, job_id: str, executor_id: str) -> int:
+        """Restart a COMPLETED job whose result partitions died with their
+        executor before the client fetched them (PR 5 residue): the client
+        reports the lost location (ReportLostPartition) and the final-stage
+        tasks completed on that executor requeue through the normal
+        retry/lineage machinery — upstream outputs lost with the same
+        executor recover via the fetch_failed path when the re-run fetches
+        them. The job status flips back to running so the client's
+        GetJobStatus poll waits for the fresh result locations. Each restart
+        consumes retry budget; exhaustion fails the job (the client gets an
+        error instead of an eternal fetch loop). Returns the number of
+        restarted tasks; 0 declines the report (job not completed, or
+        nothing on that executor — e.g. a concurrent restart already moved
+        the partitions)."""
+        js = self.get_job_metadata(job_id)
+        if js is None or js.WhichOneof("status") != "completed":
+            return 0
+        tasks = self.get_job_tasks(job_id)
+        if not tasks:
+            return 0
+        final_stage = max(t.partition_id.stage_id for t in tasks)
+        limit = self.retry_limit(job_id)
+        restarted = 0
+        for t in tasks:
+            if (
+                t.partition_id.stage_id != final_stage
+                or t.WhichOneof("status") != "completed"
+                or t.completed.executor_id != executor_id
+            ):
+                continue
+            error = (
+                f"result partition lost with executor {executor_id} "
+                "before the client fetched it"
+            )
+            if not self.requeue_task(t, executor_id, error, limit):
+                exhausted = pb.TaskStatus()
+                exhausted.CopyFrom(t)
+                exhausted.failed.error = error
+                exhausted.failed.executor_id = executor_id
+                self._fail_job(job_id, _attempts_error(exhausted))
+                return restarted
+            _record_recovery("result_partition_restarted")
+            restarted += 1
+        if restarted:
+            running = pb.JobStatus()
+            running.running.SetInParent()
+            self.save_job_metadata(job_id, running)
+            _record_recovery("completed_job_restarted")
+            log.warning(
+                "restarting completed job %s: %d result partition(s) lost "
+                "with executor %s", job_id, restarted, executor_id,
+            )
+        return restarted
+
     # -- scheduling ---------------------------------------------------------
     def assign_next_schedulable_task(
         self, executor_id: str
@@ -558,8 +809,12 @@ class SchedulerState:
                 continue
             if job_id not in job_live:
                 js = self.get_job_metadata(job_id)
+                # queued = planning not yet COMMITTED (the atomic publish
+                # flips the job to running with its tasks): tasks visible
+                # under a queued job can only be leakage from a torn write
+                # on a non-transactional backend and must not be handed out
                 job_live[job_id] = js is None or js.WhichOneof("status") not in (
-                    "completed", "failed",
+                    "completed", "failed", "queued",
                 )
             if not job_live[job_id]:
                 continue
@@ -630,26 +885,60 @@ class SchedulerState:
                 running.CopyFrom(current)  # keep attempt + history
                 running.running.executor_id = executor_id
                 self.save_task_status(running)
-                self._assigned[(job_id, stage_id, partition)] = (
-                    executor_id, running.attempt, time.monotonic(),
+                self._ledger_put(
+                    (job_id, stage_id, partition), executor_id, running.attempt
                 )
                 return running, bound
         return None
 
     def reconcile_running_tasks(self, executor_id: str, running) -> int:
-        """Requeue assignments lost in transit: a ledger entry past the
-        grace period whose KV status is still Running on `executor_id` but
-        which that executor's poll no longer (or never) echoes in
-        running_tasks means the PollWork response carrying the assignment
-        never arrived — without this the task is orphaned forever (the
-        owner's lease stays fresh, so reset_lost_tasks never fires).
-        Returns the number of reclaimed assignments."""
+        """Fold one executor's in-flight echo against the assignment ledger.
+
+        An entry the owner echoes (with a matching attempt when the echo
+        carries one) is CONFIRMED: the assignment reached the executor, so
+        the entry retires from the ledger and the normal status/lease
+        machinery takes over — after a scheduler restart this is the
+        re-adoption path (the restarted scheduler never re-executes a task
+        an executor still owns). An entry past the grace period that the
+        owner's poll does not echo means the PollWork response carrying the
+        assignment never arrived — requeue it through the retry path
+        (without this the task is orphaned forever: the owner's lease stays
+        fresh, so reset_lost_tasks never fires).
+
+        `running` accepts both echo forms: RunningTaskEcho (partition +
+        attempt) and bare PartitionId (wire compat; vouches for whatever
+        attempt the ledger holds). Returns the number of RECLAIMED
+        (requeued) assignments."""
         now = time.monotonic()
-        running_keys = {
-            (p.job_id, p.stage_id, p.partition_id) for p in running
-        }
+        echo: Dict[Tuple[str, int, int], Optional[int]] = {}
+        for p in running:
+            if hasattr(p, "job_id"):  # bare PartitionId
+                echo[(p.job_id, p.stage_id, p.partition_id)] = None
+            else:  # RunningTaskEcho
+                pid = p.partition_id
+                echo[(pid.job_id, pid.stage_id, pid.partition_id)] = p.attempt
         reclaimed = 0
-        for key, (owner, attempt, t0) in list(self._assigned.items()):
+        # in-memory screens first (owner, echo confirmation, grace window):
+        # the KV read + proto parse happens ONLY for entries actually up
+        # for requeue — this loop runs under the global lock on every poll,
+        # so O(in-flight) KV reads per heartbeat would tax every executor.
+        # Entries of other owners (incl. ones superseded elsewhere) are
+        # cleaned on their owner's polls or by accept_task_status.
+        for key, (owner, attempt, t0, restored) in list(self._assigned.items()):
+            if owner != executor_id:
+                continue  # only the owner's polls can vouch for it
+            if key in echo and echo[key] in (None, attempt):
+                # confirmed started (a stale-attempt echo does NOT count);
+                # status/lease machinery takes over from here
+                self._ledger_del(key)
+                if restored:
+                    _record_recovery("restart_readopted")
+                    log.info(
+                        "restart reconciliation: executor %s re-adopted "
+                        "task %s/%s/%s (attempt %d)",
+                        owner, key[0], key[1], key[2], attempt,
+                    )
+                continue
             if now - t0 < ORPHANED_ASSIGNMENT_GRACE_SECS:
                 continue
             cur = self.get_task_status(*key)
@@ -659,13 +948,9 @@ class SchedulerState:
                 or cur.attempt != attempt
                 or cur.running.executor_id != owner
             ):
-                del self._assigned[key]  # resolved or superseded elsewhere
+                self._ledger_del(key)  # resolved or superseded elsewhere
                 continue
-            if owner != executor_id:
-                continue  # only the owner's polls can vouch for it
-            del self._assigned[key]
-            if key in running_keys:
-                continue  # confirmed started; status/lease machinery takes over
+            self._ledger_del(key)
             error = (
                 f"assignment never reached executor {owner} "
                 "(PollWork response lost in transit)"

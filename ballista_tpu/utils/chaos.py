@@ -31,11 +31,17 @@ log = logging.getLogger("ballista.chaos")
 # The registered injection sites. Adding a site means adding it HERE first;
 # call sites naming anything else raise (and fail ballista-lint).
 SITES = (
-    "flight.fetch",     # shuffle piece fetch (distributed/stages.py)
-    "rpc.call",         # scheduler gRPC client call (scheduler/rpc.py)
-    "task.execute",     # task execution on the executor (execution_loop.py)
-    "kv.put",           # scheduler KV write (scheduler/state.py)
-    "executor.death",   # executor hard-death (execution_loop.py run loop)
+    "flight.fetch",          # shuffle piece fetch (distributed/stages.py)
+    "rpc.call",              # scheduler gRPC client call (scheduler/rpc.py)
+    "task.execute",          # task execution on the executor (execution_loop.py)
+    "kv.put",                # scheduler KV write (scheduler/state.py)
+    "executor.death",        # executor hard-death (execution_loop.py run loop)
+    "scheduler.plan_write",  # staged planning write (scheduler/state.py
+                             # JobPlanBatch) — aborts the whole atomic plan
+                             # publish; planning retries with a rotated key
+    "scheduler.crash",       # scheduler hard-death mid-PollWork
+                             # (scheduler/server.py) — keyed on the accepted-
+                             # status sequence so the crash lands mid-job
 )
 
 _DENOM = float(1 << 64)

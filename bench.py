@@ -322,10 +322,13 @@ def _join_snapshot(iters: int = 1) -> dict | None:
 def _recovery_snapshot() -> dict | None:
     """Drain the failure-recovery accumulator (ops/runtime.py): task
     retries, lineage recomputes (fetch_failed/map_recomputed), lost-task
-    resets, transient-RPC retries, and chaos injections since the last
-    drain. Raw event TOTALS, never per-query — recovery work is driven by
-    faults, not by the query loop shape. None on a fault-free run (the
-    common case: every counter zero)."""
+    resets, transient-RPC retries, chaos injections, and the ISSUE 6
+    scheduler-restart events (scheduler_restart, restart_job_resumed,
+    restart_assignment_restored, restart_readopted, torn_job_discarded,
+    plan_retry, result_partition_restarted, completed_job_restarted)
+    since the last drain. Raw event TOTALS, never per-query — recovery
+    work is driven by faults, not by the query loop shape. None on a
+    fault-free run (the common case: every counter zero)."""
     try:
         from ballista_tpu.ops.runtime import recovery_stats
 

@@ -30,7 +30,10 @@ _CHAOS_MODULE_SUFFIX = "ballista_tpu/utils/chaos.py"
 # fallback if chaos.py cannot be located from the scanned file (fixtures
 # analyzed outside the repo tree); keep in sync with utils/chaos.py::SITES
 _DEFAULT_SITES = frozenset(
-    {"flight.fetch", "rpc.call", "task.execute", "kv.put", "executor.death"}
+    {
+        "flight.fetch", "rpc.call", "task.execute", "kv.put",
+        "executor.death", "scheduler.plan_write", "scheduler.crash",
+    }
 )
 
 _sites_cache: Dict[str, frozenset] = {}

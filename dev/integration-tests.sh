@@ -59,3 +59,13 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
 # the chaos runs must stay bit-identical to the fault-free runs.
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_chaos.py tests/test_fault_tolerance.py
+
+# strict gate on scheduler crash tolerance (ISSUE 6): the durable
+# assignment ledger + restart reconciliation (seeded scheduler.crash +
+# restart on the same SqliteBackend store, bit-identical, no owned task
+# re-executed), torn-planning-write atomicity, the fetch-time restart of
+# completed jobs with lost result partitions, and the distributed fuzz
+# slice with the chaos sites folded in.
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_scheduler_restart.py \
+    "tests/test_fuzz_device.py::test_fuzz_distributed_two_stage_chaos"

@@ -112,13 +112,95 @@ def edit_issue5_orphan_reconcile(fdp) -> None:
     )
 
 
+def edit_issue6_scheduler_restart(fdp) -> None:
+    """ISSUE 6: scheduler crash tolerance.
+
+    Adds (all wire-compatible field/message additions):
+    - Assignment message: the durable assignment-ledger value stored under
+      /ballista/{ns}/assignments/{job}/{stage}/{part} — a restarted
+      scheduler reloads in-flight assignments from it
+    - RunningTaskEcho message + PollWorkParams.running_echo: the
+      attempt-enriched form of the running_tasks echo, so reconciliation
+      (and restart re-adoption) can match the ECHOED attempt against the
+      ledger instead of vouching for any attempt of the task
+    - ReportLostPartitionParams/Result + the ReportLostPartition RPC: a
+      client that hits a fetch failure against a COMPLETED job's result
+      partition reports the lost location; the scheduler restarts the lost
+      final-stage tasks through the normal lineage/retry path
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+
+    asg = fdp.message_type.add()
+    asg.name = "Assignment"
+    add_field(asg, "executor_id", 1, STR)
+    add_field(asg, "attempt", 2, U32)
+
+    echo = fdp.message_type.add()
+    echo.name = "RunningTaskEcho"
+    add_field(echo, "partition_id", 1, MSG, type_name=".ballista.PartitionId")
+    add_field(echo, "attempt", 2, U32)
+
+    add_field(
+        msgs["PollWorkParams"], "running_echo", 5, MSG,
+        label=REP, type_name=".ballista.RunningTaskEcho",
+    )
+
+    rp = fdp.message_type.add()
+    rp.name = "ReportLostPartitionParams"
+    add_field(rp, "job_id", 1, STR)
+    add_field(rp, "executor_id", 2, STR)
+    add_field(rp, "stage_id", 3, U32)
+    add_field(rp, "partition_id", 4, U32)
+    add_field(rp, "path", 5, STR)
+
+    rr = fdp.message_type.add()
+    rr.name = "ReportLostPartitionResult"
+    add_field(rr, "restarted", 1, 8)  # 8 = TYPE_BOOL
+    add_field(rr, "tasks_restarted", 2, U32)
+
+    svc = {s.name: s for s in fdp.service}.get("SchedulerGrpc")
+    if svc is not None:
+        m = svc.method.add()
+        m.name = "ReportLostPartition"
+        m.input_type = ".ballista.ReportLostPartitionParams"
+        m.output_type = ".ballista.ReportLostPartitionResult"
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
-APPLIED = [edit_issue5_failure_recovery, edit_issue5_orphan_reconcile]
+APPLIED = [
+    edit_issue5_failure_recovery,
+    edit_issue5_orphan_reconcile,
+    edit_issue6_scheduler_restart,
+]
 
 
 def emit(blob: bytes, out_path: str) -> None:
     with open(out_path, "w") as f:
         f.write(_HEADER.format(blob=blob))
+
+
+def apply_edits(names) -> int:
+    """Apply the named edit batches (functions above) to the serialized
+    FileDescriptorProto embedded in the checked-in ballista_pb2.py and
+    re-emit the module. Batches already baked into the blob must NOT be
+    re-applied (duplicate fields would corrupt the descriptor) — pass only
+    the NEW batch names, then append them to APPLIED."""
+    from google.protobuf import descriptor_pb2
+
+    from ballista_tpu.proto import ballista_pb2 as pb
+
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.ParseFromString(pb.DESCRIPTOR.serialized_pb)
+    table = {f.__name__: f for f in APPLIED}
+    for name in names:
+        if name not in table:
+            print(f"unknown edit batch {name!r}; known: {sorted(table)}")
+            return 2
+        table[name](fdp)
+    out = __file__.rsplit("/", 2)[0] + "/ballista_tpu/proto/ballista_pb2.py"
+    emit(fdp.SerializeToString(), out)
+    print(f"applied {list(names)} -> {out}")
+    return 0
 
 
 def check() -> int:
@@ -149,7 +231,14 @@ def check() -> int:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--check", action="store_true", help="verify the module")
+    ap.add_argument(
+        "--apply", nargs="+", metavar="EDIT",
+        help="apply the named NEW edit batches to the checked-in blob and "
+        "re-emit ballista_pb2.py (do not name batches already baked in)",
+    )
     args = ap.parse_args()
+    if args.apply:
+        return apply_edits(args.apply)
     if args.check:
         return check()
     ap.print_help()

@@ -90,10 +90,37 @@ class _Client:
             expires = time.time() + lease.ttl if lease is not None else None
             self._server.data[key] = (bytes(value), expires)
 
+    def delete(self, key: str) -> None:
+        with self._server.mu:
+            self._server.data.pop(key, None)
+
     def delete_prefix(self, prefix: str) -> None:
         with self._server.mu:
             for k in [k for k in self._server.data if k.startswith(prefix)]:
                 del self._server.data[k]
+
+    # -- transactions ----------------------------------------------------
+    @property
+    def transactions(self):
+        """etcd3's client.transactions op-builder namespace; only `put` is
+        modeled (EtcdBackend.put_all builds unconditional success puts)."""
+        class _Txns:
+            @staticmethod
+            def put(key, value, lease=None):
+                return ("put", key, value)
+
+        return _Txns()
+
+    def transaction(self, compare, success, failure):
+        if compare or failure:
+            raise NotImplementedError("fake etcd3 models compare-less txns only")
+        with self._server.mu:
+            for op, key, value in success:
+                assert op == "put"
+                if isinstance(value, str):
+                    value = value.encode()
+                self._server.data[key] = (bytes(value), None)
+        return (True, [])
 
     # -- lease / lock ---------------------------------------------------
     def lease(self, ttl: int) -> _Lease:

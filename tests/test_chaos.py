@@ -233,14 +233,11 @@ def test_chaos_executor_death_recovers_bit_identical(sales_table):
         _register(ctx, sales_table)
         out = {}
         for name, sql in (("group_by", GROUP_BY_SQL), ("join", JOIN_SQL)):
-            try:
-                out[name] = ctx.sql(sql).collect()
-            except RpcError:
-                # narrow race: the job completed with final partitions on
-                # the executor that chaos-killed right after — resubmit once
-                # (a job-level restart is future work; recovery of IN-FLIGHT
-                # jobs is what this test pins)
-                out[name] = ctx.sql(sql).collect()
+            # a job that COMPLETED with final partitions on the executor
+            # that chaos-killed right after is restarted through lineage by
+            # the fetch-time ReportLostPartition path (ISSUE 6) — no
+            # resubmission workaround needed anymore
+            out[name] = ctx.sql(sql).collect()
         ctx.close()
         for name in ("group_by", "join"):
             assert out[name].equals(clean[name]), (

@@ -642,6 +642,86 @@ def test_end_to_end_recovery_after_executor_death_with_lost_outputs(sales_table)
         cluster.shutdown()
 
 
+def test_completed_job_with_lost_result_partitions_restarts(sales_table):
+    """PR 5 residue (ISSUE 6 satellite): a COMPLETED job whose result
+    partitions died with their executor BEFORE the client fetched them was
+    never restarted — reset_lost_tasks skips terminal jobs, so the client's
+    fetch surfaced an RpcError (pre-fix this test fails exactly there).
+    Now the client detects the loss at fetch time (ShuffleFetchError
+    against the terminal job), reports it via ReportLostPartition, and the
+    scheduler restarts the lost final-stage tasks through the normal
+    lineage/retry machinery — the collect returns correct results."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import recovery_stats
+    import ballista_tpu.scheduler.state as state_mod
+
+    cluster = StandaloneCluster(n_executors=2)
+    old_lease = state_mod.EXECUTOR_LEASE_SECS
+    state_mod.EXECUTOR_LEASE_SECS = 1.0
+    cluster.scheduler_impl.lost_task_check_interval = 0.3
+    recovery_stats(reset=True)
+    try:
+        ctx = BallistaContext(*cluster.scheduler_addr)
+        ctx.register_record_batches("sales", sales_table, n_partitions=4)
+        df = ctx.sql(
+            "select region, sum(amount) as s from sales group by region order by region"
+        )
+        plan = df.logical_plan()
+        job_id = ctx.submit(plan)
+        status = ctx._wait_for_job(job_id, timeout=60.0)
+
+        # kill ONE executor holding a result partition — totally (heartbeat
+        # AND data plane) — BEFORE anything is fetched; the survivor must
+        # recompute its partitions after the fetch-time report
+        owners = [
+            pl.executor_meta.id for pl in status.completed.partition_location
+        ]
+        assert owners, "completed job must expose result locations"
+        victim = next(ex for ex in cluster.executors if ex.id in owners)
+        victim.stop()
+
+        out = ctx._collect_results(job_id, plan.schema(), timeout=120.0)
+        assert out.column("s").to_pylist() == [120.0, 40.0, 145.0]
+
+        stats = recovery_stats()
+        assert stats.get("result_partition_restarted", 0) > 0, stats
+        assert stats.get("completed_job_restarted", 0) > 0, stats
+        assert stats.get("result_fetch_restarted", 0) > 0, stats
+        ctx.close()
+    finally:
+        state_mod.EXECUTOR_LEASE_SECS = old_lease
+        cluster.shutdown()
+
+
+def test_restart_completed_job_declines_non_terminal_and_unknown():
+    """ReportLostPartition is a no-op (restarted=False) for running or
+    unknown jobs and for executors that hold no final-stage output — the
+    client re-raises its fetch error instead of looping."""
+    s = SchedulerState(MemoryBackend(), "t")
+    assert s.restart_completed_job("nope", "e1") == 0
+    _running_job(s, "jr")
+    s.save_task_status(_task("jr", 1, 0, "completed", "e1"))
+    assert s.restart_completed_job("jr", "e1") == 0  # running, not completed
+    done = pb.JobStatus()
+    done.completed.SetInParent()
+    s.save_job_metadata("jc", done)
+    s.save_task_status(_task("jc", 1, 0, "completed", "e1"))
+    s.save_task_status(_task("jc", 2, 0, "completed", "e1"))
+    s.save_task_status(_task("jc", 2, 1, "completed", "e2"))
+    assert s.restart_completed_job("jc", "e9") == 0  # e9 holds nothing
+    assert s.get_job_metadata("jc").WhichOneof("status") == "completed"
+    # e1's FINAL-stage task restarts (stage-1 output stays; lineage handles
+    # it only if the re-run's fetch actually fails)
+    assert s.restart_completed_job("jc", "e1") == 1
+    assert s.get_job_metadata("jc").WhichOneof("status") == "running"
+    t = s.get_task_status("jc", 2, 0)
+    assert t.WhichOneof("status") is None and t.attempt == 1
+    assert "result partition lost" in t.history[0].error
+    # the untouched final task keeps its completed location
+    assert s.get_task_status("jc", 2, 1).WhichOneof("status") == "completed"
+
+
 def test_work_dir_gc(tmp_path):
     from ballista_tpu.executor.execution_loop import PollLoop
 

@@ -340,6 +340,93 @@ def test_fuzz_duplicate_key_joins(tmp_path, seed):
     assert out["tpu"].to_pylist() == out["cpu"].to_pylist(), (shape, how)
 
 
+def _distributed_fuzz_queries(qrng, k=2):
+    """Random 2-stage (partial agg -> shuffle -> final agg) queries from the
+    dedicated 15000+ stream. Aggregates restricted to orders the
+    distributed fold computes deterministically under retries (it does for
+    all of them — partials are per-partition and partitioning is by hash)."""
+    aggs = ["sum(v)", "count(*)", "min(q)", "max(q)", "sum(q)"]
+    out = []
+    for _ in range(k):
+        key = str(qrng.choice(["g", "s", "g, s"]))
+        picks = list(qrng.choice(aggs, size=int(qrng.integers(1, 4)),
+                                 replace=False))
+        sel = ", ".join([key] + [f"{a} as a{i}" for i, a in enumerate(picks)])
+        sql = f"select {sel} from t"
+        if qrng.random() < 0.5:
+            sql += " where " + str(qrng.choice(
+                ["v > 0", "q < 30", "s <> 't2'", "g % 7 <> 3"]
+            ))
+        out.append(sql + f" group by {key} order by {key}")
+    return out
+
+
+def _run_distributed(table, queries, client_settings, cluster_config=None):
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    cluster = StandaloneCluster(n_executors=2, config=cluster_config)
+    try:
+        ctx = BallistaContext(*cluster.scheduler_addr, settings=client_settings)
+        ctx.register_record_batches("t", table, n_partitions=4)
+        out = [ctx.sql(sql).collect() for sql in queries]
+        ctx.close()
+        return out
+    finally:
+        cluster.shutdown()
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_distributed_two_stage_chaos(seed):
+    """ROADMAP fuzzer slice (ISSUE 6 satellite): random 2-stage plans
+    through the REAL scheduler + executors, run fault-free and then with
+    the PR 5/6 chaos sites armed at a seeded nonzero rate — task faults,
+    fetch faults, scheduler KV-write faults, and torn planning writes must
+    all recover to BIT-IDENTICAL results. Own rng streams (14000+ data,
+    15000+ queries), so every baseline stream above stays byte-identical."""
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.ops.runtime import recovery_stats
+
+    rng = np.random.default_rng(14000 + seed)
+    qrng = np.random.default_rng(15000 + seed)
+    _fresh()
+    n = int(rng.integers(2_000, 8_000))
+    table = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+            "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+            "s": pa.array([f"t{x}" for x in rng.integers(0, 5, n)]),
+        }
+    )
+    queries = _distributed_fuzz_queries(qrng)
+
+    clean = _run_distributed(
+        table, queries, {"ballista.shuffle.partitions": "4"}
+    )
+    # executor-side sites ride the per-job client settings; scheduler-side
+    # sites (kv.put, scheduler.plan_write) arm through the cluster config
+    chaos_client = {
+        "ballista.shuffle.partitions": "4",
+        "ballista.chaos.rate": "0.05",
+        "ballista.chaos.seed": str(70 + seed),
+        "ballista.chaos.sites": "task.execute,flight.fetch",
+        "ballista.shuffle.max_task_retries": "5",
+    }
+    chaos_cluster = BallistaConfig({
+        "ballista.chaos.rate": "0.02",
+        "ballista.chaos.seed": str(70 + seed),
+        "ballista.chaos.sites": "kv.put,scheduler.plan_write",
+        "ballista.shuffle.max_task_retries": "5",
+    })
+    recovery_stats(reset=True)
+    chaotic = _run_distributed(table, queries, chaos_client, chaos_cluster)
+    stats = recovery_stats(reset=True)
+    for sql, c, t in zip(queries, clean, chaotic):
+        assert t.equals(c), (sql, t.to_pydict(), c.to_pydict())
+    assert stats.get("chaos_injected", 0) > 0, stats
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_fuzz_float_extrema_minmax(tmp_path, seed):
     """Dedicated float-extrema sweep: MIN/MAX over NaN/±0/subnormal/

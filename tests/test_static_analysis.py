@@ -128,6 +128,24 @@ def test_failure_rule_flags_all_four_shapes():
     assert any("ad-hoc" in m and "ChaosInjected" in m for m in findings)
 
 
+def test_failure_rule_scheduler_site_fixture_pair():
+    """ISSUE 6 satellite: unregistered or computed (non-literal) chaos site
+    names in SCHEDULER code fail lint; the registered-literal plan-write /
+    crash shapes are clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_sched_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "scheduler.plan_commit" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_sched_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_failure_rule_sites_track_chaos_registry():
     """The rule reads SITES from ballista_tpu/utils/chaos.py, so the two
     can't drift silently."""
