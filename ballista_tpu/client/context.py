@@ -34,6 +34,15 @@ from ballista_tpu.serde.logical import plan_to_proto
 POLL_INTERVAL = 0.1  # ref context.rs:195
 
 
+class _CachedResultLost(BallistaError):
+    """A result-cache-served job's partitions died before the fetch; the
+    scheduler invalidated the entry — collect() resubmits the plan once."""
+
+    def __init__(self, job_id: str) -> None:
+        super().__init__(f"cached result partitions of job {job_id} lost")
+        self.job_id = job_id
+
+
 class BallistaContext(ExecutionContext):
     """Client context talking to a remote scheduler (ref BallistaContext::remote)."""
 
@@ -77,7 +86,29 @@ class BallistaContext(ExecutionContext):
     # -- execution ---------------------------------------------------------
     def collect(self, plan: lp.LogicalPlan, timeout: float = 300.0) -> pa.Table:
         job_id = self.submit(plan)
-        return self._collect_results(job_id, plan.schema(), timeout)
+        try:
+            return self._collect_results(job_id, plan.schema(), timeout)
+        except _CachedResultLost:
+            # the scheduler served this job from the result cache but the
+            # cached partitions died under a live lease; it invalidated the
+            # entry and failed the job. ONE resubmission re-executes for
+            # real (the fresh submission misses the now-deleted entry).
+            from ballista_tpu.ops.runtime import record_tenancy
+
+            record_tenancy("cache_lost_resubmitted")
+            job_id = self.submit(plan)
+            try:
+                return self._collect_results(job_id, plan.schema(), timeout)
+            except _CachedResultLost as e:
+                # the resubmission ALSO rode a (concurrently re-published)
+                # dead entry: the cluster is churning faster than the cache
+                # invalidates — surface a public error, not the internal
+                # retry marker
+                raise ExecutionError(
+                    f"job {e.job_id}: cached result partitions lost twice "
+                    "in a row (executor churn outpacing cache "
+                    "invalidation) — retry the query"
+                ) from e
 
     def submit(self, plan: lp.LogicalPlan) -> str:
         """ExecuteQuery only: returns the job id without waiting for (or
@@ -88,6 +119,10 @@ class BallistaContext(ExecutionContext):
         # configs per job without clobbering host-local tuning
         for k, v in self.config.explicit_settings().items():
             params.settings.add(key=k, value=v)
+        # tenancy rides first-class fields too (ISSUE 7): admission control
+        # must not depend on parsing the settings map
+        params.tenant = self.config.tenant()
+        params.priority = self.config.tenant_priority()
         return self._client.execute_query(params).job_id
 
     def _collect_results(
@@ -112,6 +147,7 @@ class BallistaContext(ExecutionContext):
                     for loc in status.completed.partition_location
                 ]
             except ShuffleFetchError as e:
+                cached = status.completed.cached
                 result = self._client.report_lost_partition(
                     pb.ReportLostPartitionParams(
                         job_id=job_id,
@@ -122,6 +158,10 @@ class BallistaContext(ExecutionContext):
                     )
                 )
                 if not result.restarted:
+                    if cached:
+                        # cache-served job: the scheduler invalidated the
+                        # entry; collect() resubmits the plan once
+                        raise _CachedResultLost(job_id) from e
                     # nothing for the scheduler to restart (or the job
                     # already failed for good): surface the fetch error
                     raise

@@ -88,6 +88,30 @@ BALLISTA_MAX_TASK_RETRIES = "ballista.shuffle.max_task_retries"
 # exponential backoff base between them
 BALLISTA_RPC_RETRIES = "ballista.rpc.retries"
 BALLISTA_RPC_BACKOFF_MS = "ballista.rpc.backoff_ms"
+# -- multi-tenant serving (ISSUE 7) -----------------------------------------
+# which tenant this client submits as ("" = the default unnamed tenant) and
+# the optional per-job priority (higher schedules first within the tenant).
+# Both ride ExecuteQueryParams as first-class fields; the scheduler persists
+# them per job (tenants/{job}) so admission survives a restart.
+BALLISTA_TENANT = "ballista.tenant.name"
+BALLISTA_TENANT_PRIORITY = "ballista.tenant.priority"
+# scheduler-side admission control: max tasks a single tenant may have
+# in flight across the cluster (0 = unlimited). A tenant at its quota is
+# skipped by assignment until its running tasks drain — a saturating
+# tenant's SF=100 scan cannot starve another tenant's point query.
+BALLISTA_TENANT_MAX_INFLIGHT = "ballista.tenant.max_inflight"
+# weighted fair share: "alice:4,bob:1" gives alice 4x bob's share of
+# assignment slots when both have pending work; unlisted tenants weigh 1.
+BALLISTA_TENANT_WEIGHTS = "ballista.tenant.weights"
+# plan-fingerprint result cache (scheduler-side): a completed job's result
+# partition locations are indexed under sha256(normalized logical plan +
+# input file mtimes + result-affecting settings); a repeated identical
+# query over unchanged inputs completes instantly with ZERO executor tasks.
+BALLISTA_RESULT_CACHE = "ballista.cache.results"
+# cross-job physical-plan sharing (scheduler-side): optimize+physical
+# planning output is content-keyed (fingerprint sans mtimes), so N tenants
+# submitting the same dashboard query plan it once.
+BALLISTA_PLAN_CACHE = "ballista.cache.plans"
 # -- deterministic fault injection (utils/chaos.py) -------------------------
 # rate > 0 arms the registered injection sites; each (site, key) pair draws
 # a DETERMINISTIC verdict from sha256(seed, site, key), so a chaos run is
@@ -134,6 +158,12 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_INGEST_DEPTH: "2",
     BALLISTA_DATA_ROOTS: "",
     BALLISTA_MAX_TASK_RETRIES: "3",
+    BALLISTA_TENANT: "",
+    BALLISTA_TENANT_PRIORITY: "0",
+    BALLISTA_TENANT_MAX_INFLIGHT: "0",
+    BALLISTA_TENANT_WEIGHTS: "",
+    BALLISTA_RESULT_CACHE: "true",
+    BALLISTA_PLAN_CACHE: "true",
     BALLISTA_RPC_RETRIES: "3",
     BALLISTA_RPC_BACKOFF_MS: "50",
     BALLISTA_CHAOS_SEED: "0",
@@ -243,6 +273,40 @@ class BallistaConfig(Mapping[str, str]):
         """Requeues allowed per task before the job fails (0 = reference
         behavior: first failure kills the job)."""
         return max(0, int(self._settings[BALLISTA_MAX_TASK_RETRIES]))
+
+    def tenant(self) -> str:
+        """Submitting tenant name; "" = the default (unnamed) tenant."""
+        return self._settings[BALLISTA_TENANT].strip()
+
+    def tenant_priority(self) -> int:
+        """Per-job priority within the tenant (higher schedules first)."""
+        return max(0, int(self._settings[BALLISTA_TENANT_PRIORITY]))
+
+    def tenant_max_inflight(self) -> int:
+        """Per-tenant in-flight task quota (0 = unlimited)."""
+        return max(0, int(self._settings[BALLISTA_TENANT_MAX_INFLIGHT]))
+
+    def tenant_weights(self) -> Dict[str, int]:
+        """Fair-share weights parsed from "alice:4,bob:1"; absent -> 1."""
+        out: Dict[str, int] = {}
+        for part in self._settings[BALLISTA_TENANT_WEIGHTS].split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.rpartition(":")
+            if not name:
+                raise ValueError(
+                    f"bad {BALLISTA_TENANT_WEIGHTS} entry {part!r} "
+                    "(expected tenant:weight)"
+                )
+            out[name.strip()] = max(1, int(w))
+        return out
+
+    def result_cache(self) -> bool:
+        return self._settings[BALLISTA_RESULT_CACHE].lower() in ("1", "true", "yes")
+
+    def plan_cache(self) -> bool:
+        return self._settings[BALLISTA_PLAN_CACHE].lower() in ("1", "true", "yes")
 
     def rpc_retries(self) -> int:
         """Transient-RPC retry attempts beyond the first call."""

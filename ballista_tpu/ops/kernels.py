@@ -149,6 +149,15 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     from ballista_tpu.ops.stage import FusedAggregateStage
 
     _configure_jax_cache()
+    # COUNT-over-LEFT-join as device membership counting (q13): the
+    # per-probe counts plane replaces the join expansion entirely. A cheap
+    # shape prescreen — non-matching aggregates fall through to the ladder
+    if ctx.config.tpu_device_join():
+        from ballista_tpu.ops.countjoin import try_count_left_join
+
+        counted = try_count_left_join(exec_node, partition, ctx)
+        if counted is not None:
+            return counted
     # structural cache: identical plan shapes (the common case for repeated
     # queries) share one stage — and with it the jit trace/compile cache.
     # Memory scans carry no identity in their display: include source ids so

@@ -765,3 +765,26 @@ def recovery_stats(reset: bool = False) -> Dict[str, int]:
         if reset:
             _recovery.clear()
     return out
+
+
+# accumulated multi-tenant serving events (ISSUE 7): result-cache hits /
+# misses / puts / invalidations, plan-cache hits, and admission quota
+# deferrals. Same in-process accumulator pattern as the recovery counters;
+# bench.py's multi-tenant scenario reports cache-hit rate and per-tenant
+# fairness off these plus the scheduler's per-tenant assignment ledger.
+_tenancy_lock = threading.Lock()
+_tenancy: Dict[str, int] = {}  # event -> count; guarded-by: _tenancy_lock
+
+
+def record_tenancy(event: str, n: int = 1) -> None:
+    with _tenancy_lock:
+        _tenancy[event] = _tenancy.get(event, 0) + int(n)
+
+
+def tenancy_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated multi-tenant serving counters."""
+    with _tenancy_lock:
+        out = dict(_tenancy)
+        if reset:
+            _tenancy.clear()
+    return out

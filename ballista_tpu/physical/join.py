@@ -95,11 +95,28 @@ class HashJoinExec(ExecutionPlan):
                 [build.column(k) for k in right_keys],
                 [probe.column(k) for k in left_keys],
             )
-            if self.filter is None:
-                how = "semi_right" if self.join_type == JoinType.SEMI else "anti_right"
-                keep_idx, _ = join_indices(bcodes, pcodes, how)
-            else:
-                keep_idx = self._filtered_semi_indices(build, probe, bcodes, pcodes)
+            keep_idx = None
+            if (self.filter is None and ctx.backend == "tpu"
+                    and ctx.config.tpu_device_join()):
+                # EXISTS / NOT EXISTS as device membership counting (q22):
+                # the per-probe counts plane decides kept rows — counts > 0
+                # keeps SEMI rows, counts == 0 keeps ANTI rows — exactly
+                # the host oracle's semi_right/anti_right selections, so
+                # results are bit-identical. Declines (None, with a
+                # recorded reason) fall through to the host path.
+                from ballista_tpu.ops.join import device_membership_counts
+
+                counts = device_membership_counts(bcodes, pcodes)
+                if counts is not None:
+                    keep = counts > 0 if self.join_type == JoinType.SEMI \
+                        else counts == 0
+                    keep_idx = np.nonzero(keep)[0]
+            if keep_idx is None:
+                if self.filter is None:
+                    how = "semi_right" if self.join_type == JoinType.SEMI else "anti_right"
+                    keep_idx, _ = join_indices(bcodes, pcodes, how)
+                else:
+                    keep_idx = self._filtered_semi_indices(build, probe, bcodes, pcodes)
             out = probe.take(pa.array(keep_idx))
             yield from batch_table(out, ctx.batch_size)
             return

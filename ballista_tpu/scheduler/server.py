@@ -82,6 +82,16 @@ class SchedulerServer:
         # RPC worker threads it may hold at once so a burst of large metadata
         # requests can never starve PollWork heartbeats of workers
         self._file_meta_slots = threading.BoundedSemaphore(4)
+        # cross-job physical-plan cache (ISSUE 7): optimize + physical
+        # planning output serialized per CONTENT key (plan proto + settings,
+        # no mtimes — planning depends on the file LIST, not file contents),
+        # so N tenants submitting the same dashboard query plan once. The
+        # cached value is the serialized proto, deserialized fresh per job:
+        # plan trees are mutable (stage split, operator state) and must
+        # never be shared across planner invocations.
+        self._plan_cache_mu = threading.Lock()
+        self._plan_cache: "dict[str, bytes]" = {}  # guarded-by: self._plan_cache_mu
+        self._plan_cache_cap = 128
 
     # -- crash simulation ---------------------------------------------------
     def _refuse_if_crashed(self, context) -> None:
@@ -160,23 +170,72 @@ class SchedulerServer:
         else:
             raise ValueError("ExecuteQueryParams requires a plan or sql")
 
+        from ballista_tpu.config import BALLISTA_TENANT, BALLISTA_TENANT_PRIORITY
+        from ballista_tpu.ops.runtime import record_tenancy
+        from ballista_tpu.scheduler.fingerprint import plan_fingerprint
+
+        # tenancy (ISSUE 7): the proto field is authoritative; settings keep
+        # wire compat with clients that only flow the config map
+        tenant = request.tenant or settings.get(BALLISTA_TENANT, "").strip()
+        try:
+            # clamp: pb.JobTenant.priority is uint32 — a negative settings
+            # value must degrade to 0, not kill the submission
+            priority = request.priority or max(0, int(
+                settings.get(BALLISTA_TENANT_PRIORITY, "0") or 0
+            ))
+        except ValueError:
+            priority = 0
+
+        # plan-fingerprint identity (None when any source is neither
+        # file-backed nor content-embedded — such plans never cache)
+        fp = None
+        if config.result_cache() or config.plan_cache():
+            fp = plan_fingerprint(plan, settings)
+        if fp is None and config.result_cache():
+            record_tenancy("cache_unkeyable")
+
         job_id = _job_id()
+        if fp is not None and config.result_cache():
+            # result-cache lookup + job publish under the global lock so a
+            # concurrent completion's cache put cannot interleave
+            with self.state.kv.lock():
+                hit = self.state.result_cache_lookup(fp[1])
+                if hit is not None:
+                    completed = pb.JobStatus()
+                    completed.completed.CopyFrom(hit)
+                    self.state.save_job_metadata(job_id, completed)
+                    self.state.save_job_tenant(job_id, tenant, priority)
+                    # link job -> entry so a lost cached result partition
+                    # (ReportLostPartition) invalidates the right entry
+                    self.state.save_job_fingerprint(job_id, fp[1])
+                    log.info(
+                        "job %s served from result cache (tenant=%s, fp=%s...)",
+                        job_id, tenant or "<default>", fp[1][:16],
+                    )
+                    return pb.ExecuteQueryResult(job_id=job_id)
+
         queued = pb.JobStatus()
         queued.queued.SetInParent()
         self.state.save_job_metadata(job_id, queued)
         # per-job client settings ride TaskDefinition to executors (the
         # reference drops its settings map, serde/scheduler/to_proto.rs:29-35)
         self.state.save_job_settings(job_id, settings)
+        self.state.save_job_tenant(job_id, tenant, priority)
+        if fp is not None and config.result_cache():
+            self.state.save_job_fingerprint(job_id, fp[1])
 
+        content_key = fp[0] if (fp is not None and config.plan_cache()) else None
         if self.synchronous_planning:
-            self._plan_job(job_id, plan, config)
+            self._plan_job(job_id, plan, config, content_key=content_key)
         else:
             threading.Thread(
-                target=self._plan_job_safe, args=(job_id, plan, config), daemon=True
+                target=self._plan_job_safe,
+                args=(job_id, plan, config, content_key),
+                daemon=True,
             ).start()
         return pb.ExecuteQueryResult(job_id=job_id)
 
-    def _plan_job_safe(self, job_id: str, plan, config) -> None:
+    def _plan_job_safe(self, job_id: str, plan, config, content_key=None) -> None:
         from ballista_tpu.ops.runtime import record_recovery
         from ballista_tpu.utils.chaos import ChaosInjected
 
@@ -192,7 +251,8 @@ class SchedulerServer:
                             "instance crashed", job_id)
                 return
             try:
-                self._plan_job(job_id, plan, config, attempt=attempt)
+                self._plan_job(job_id, plan, config, attempt=attempt,
+                               content_key=content_key)
                 return
             except ChaosInjected as e:
                 # the staged batch died before commit, so NOTHING was
@@ -221,13 +281,65 @@ class SchedulerServer:
                 self.state.save_job_metadata(job_id, failed)
                 return
 
-    def _plan_job(self, job_id: str, plan, config, attempt: int = 0) -> None:
+    def _physical_plan(self, plan, config, content_key=None):
+        """Optimize + physical-plan, through the cross-job plan cache when a
+        content key is available: a cache hit deserializes the stored proto
+        (fresh tree per job — plan nodes are mutable) instead of re-running
+        the optimizer, so N tenants submitting the same query plan once."""
         from ballista_tpu.config import BALLISTA_TPU_COALESCE_AGG
+        from ballista_tpu.ops.runtime import record_tenancy
+        from ballista_tpu.serde.physical import (
+            phys_plan_from_proto,
+            phys_plan_to_proto,
+        )
 
+        if content_key is not None:
+            with self._plan_cache_mu:
+                blob = self._plan_cache.get(content_key)
+            if blob is not None:
+                # a cached blob that stops deserializing (e.g. after a code
+                # change mid-process) must evict and fall through to fresh
+                # planning, never fail the job
+                try:
+                    node = pb.PhysicalPlanNode()
+                    node.ParseFromString(blob)
+                    plan_tree = phys_plan_from_proto(node)
+                except Exception:
+                    with self._plan_cache_mu:
+                        self._plan_cache.pop(content_key, None)
+                else:
+                    record_tenancy("plan_cache_hit")
+                    return plan_tree
         # distributed jobs keep the Partial/exchange/Final shape: the stage
         # split parallelizes across executors, and the SPMD fuse needs it
         ctx = ExecutionContext(config.with_setting(BALLISTA_TPU_COALESCE_AGG, "false"))
         physical = ctx.create_physical_plan(plan)
+        if content_key is not None:
+            # validate the blob round-trips BEFORE inserting (and hand out
+            # the fresh tree): a plan that serializes but cannot
+            # deserialize must never enter the cache — inserting first
+            # would open a window where a concurrent submission hits the
+            # poisoned entry
+            try:
+                blob = phys_plan_to_proto(physical).SerializeToString()
+                node = pb.PhysicalPlanNode()
+                node.ParseFromString(blob)
+                fresh = phys_plan_from_proto(node)
+            except Exception:
+                return physical  # unserializable plans just don't cache
+            with self._plan_cache_mu:
+                if len(self._plan_cache) >= self._plan_cache_cap:
+                    # drop the oldest insertion (dict preserves order) —
+                    # a simple bound, not an LRU; the cap is generous
+                    self._plan_cache.pop(next(iter(self._plan_cache)))
+                self._plan_cache[content_key] = blob
+            return fresh
+        return physical
+
+    def _plan_job(
+        self, job_id: str, plan, config, attempt: int = 0, content_key=None
+    ) -> None:
+        physical = self._physical_plan(plan, config, content_key)
         stages = DistributedPlanner(config).plan_query_stages(job_id, physical)
         # all-or-nothing publish: stage plans, pending tasks, and the
         # queued->running flip land in ONE KV batch, so a crash mid-plan
@@ -342,6 +454,28 @@ class SchedulerServer:
                 restarted = (
                     js is not None and js.WhichOneof("status") == "running"
                 )
+                if (
+                    js is not None
+                    and js.WhichOneof("status") == "completed"
+                    and js.completed.cached
+                ):
+                    # a CACHE-SERVED job has no tasks to restart: the data
+                    # died (or was GC'd) under a still-live lease. Eagerly
+                    # invalidate the entry and fail the job — the client
+                    # resubmits and the fresh submission misses the cache
+                    # and executes for real (client/context.py retries the
+                    # resubmission itself on collect()).
+                    fp = self.state.get_job_fingerprint(request.job_id)
+                    if fp is not None:
+                        self.state.result_cache_invalidate(fp)
+                    failed = pb.JobStatus()
+                    failed.failed.error = (
+                        "cached result partitions lost with executor "
+                        f"{request.executor_id}; the cache entry was "
+                        "invalidated — resubmit the query"
+                    )
+                    self.state.save_job_metadata(request.job_id, failed)
+                    restarted = False
         log.warning(
             "ReportLostPartition(job=%s, executor=%s, %s/%s): restarted %d",
             request.job_id, request.executor_id,

@@ -11,6 +11,9 @@ backend resumes mid-job. Key layout (ref state/mod.rs:387-434):
     stages/{job_id}/{stage_id}      PhysicalPlanNode (the stage plan)
     tasks/{job_id}/{stage_id}/{p}   TaskStatus (empty oneof = pending)
     assignments/{job_id}/{stage}/{p} Assignment (durable in-flight ledger)
+    tenants/{job_id}                JobTenant (tenant + priority, ISSUE 7)
+    jobfp/{job_id}                  result-cache fingerprint of the job
+    resultcache/{fingerprint}       ResultCacheEntry (completed locations)
     meta/restart_generation         int (bumped by each restart recovery)
 
 Crash tolerance (ISSUE 6): planning writes publish atomically through
@@ -26,6 +29,7 @@ normal retry/lineage path.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -55,6 +59,12 @@ def _record_recovery(event: str, n: int = 1) -> None:
     from ballista_tpu.ops.runtime import record_recovery
 
     record_recovery(event, n)
+
+
+def _record_tenancy(event: str, n: int = 1) -> None:
+    from ballista_tpu.ops.runtime import record_tenancy
+
+    record_tenancy(event, n)
 
 
 def _attempts_error(t: pb.TaskStatus) -> str:
@@ -101,6 +111,10 @@ class _TaskIndex:
         self.pending: Dict[Tuple[str, int], set] = {}
         self.incomplete: Dict[Tuple[str, int], set] = {}
         self.total: Dict[Tuple[str, int], set] = {}
+        # in-flight partitions per stage (status oneof == running): the
+        # per-tenant in-flight totals behind admission quotas and weighted
+        # fair share (ISSUE 7) sum these through the job->tenant map
+        self.running: Dict[Tuple[str, int], set] = {}
 
     def observe(self, status: pb.TaskStatus) -> None:
         pid = status.partition_id
@@ -112,6 +126,10 @@ class _TaskIndex:
             self.pending.setdefault(key, set()).add(part)
         else:
             self._drop(self.pending, key, part)
+        if w == "running":
+            self.running.setdefault(key, set()).add(part)
+        else:
+            self._drop(self.running, key, part)
         if w == "completed":
             self._drop(self.incomplete, key, part)
         else:
@@ -251,6 +269,24 @@ class SchedulerState:
         # the generation in, so a restarted scheduler draws FRESH verdicts
         # instead of deterministically re-crashing at the same point.
         self.generation = 0
+        # -- multi-tenant bookkeeping (ISSUE 7) -----------------------------
+        # read-through cache of the durable tenants/{job} records (a job's
+        # tenant is immutable, so cached entries never go stale) and the
+        # per-tenant assignment totals behind bench's fairness report.
+        # Both are touched from PollWork (under the global KV lock) AND from
+        # ExecuteQuery / test probes, so they carry their own lock.
+        self._tenant_mu = threading.Lock()
+        self._tenant_cache: Dict[str, Tuple[str, int]] = {}  # guarded-by: self._tenant_mu
+        self.tenant_assigned: Dict[str, int] = {}  # guarded-by: self._tenant_mu
+        # scheduler.admit chaos rotation: like _chaos_puts, a per-process
+        # admission sequence so a faulted admission's retry (the executor's
+        # next poll) draws a fresh deterministic verdict
+        self._admit_seq = 0  # under the kv lock (PollWork body)
+        # parse the tenancy config ONCE, here: a malformed weights string
+        # (or quota) must fail scheduler construction with a clear error,
+        # not raise inside every assignment scan and wedge all scheduling
+        self._tenant_weights = self.config.tenant_weights()
+        self._tenant_quota = self.config.tenant_max_inflight()
 
     def _key(self, *parts: str) -> str:
         return "/".join(("/ballista", self.namespace) + parts)
@@ -324,6 +360,8 @@ class SchedulerState:
                 )
                 self.save_job_metadata(job_id, failed)
                 self.kv.delete(self._key("settings", job_id))
+                self.kv.delete(self._key("tenants", job_id))
+                self.kv.delete(self._key("jobfp", job_id))
                 self.kv.delete_prefix(self._key("stages", job_id) + "/")
                 self.kv.delete_prefix(self._key("tasks", job_id) + "/")
                 bump("torn_job_discarded")
@@ -403,6 +441,120 @@ class SchedulerState:
         msg = pb.JobSettings()
         msg.ParseFromString(v)
         return {kv.key: kv.value for kv in msg.settings}
+
+    # -- tenancy (ISSUE 7) ----------------------------------------------------
+    def save_job_tenant(self, job_id: str, tenant: str, priority: int) -> None:
+        """Durable per-job tenant record: admission quotas, fair-share
+        accounting, and priority ordering survive a scheduler restart."""
+        msg = pb.JobTenant(tenant=tenant, priority=priority)
+        self.kv.put(self._key("tenants", job_id), msg.SerializeToString())
+        with self._tenant_mu:
+            self._tenant_cache[job_id] = (tenant, priority)
+
+    def job_tenant(self, job_id: str) -> Tuple[str, int]:
+        """(tenant, priority) of a job; ("", 0) for pre-tenancy jobs.
+        Read-through cached — the record is immutable per job."""
+        with self._tenant_mu:
+            hit = self._tenant_cache.get(job_id)
+            if hit is not None:
+                return hit
+            if len(self._tenant_cache) > 10_000:
+                # jobs are short-lived; a long-lived scheduler must not
+                # accumulate every job id it ever saw
+                self._tenant_cache.clear()
+        v = self.kv.get(self._key("tenants", job_id))
+        out = ("", 0)
+        if v is not None:
+            msg = pb.JobTenant()
+            msg.ParseFromString(v)
+            out = (msg.tenant, msg.priority)
+        with self._tenant_mu:
+            self._tenant_cache[job_id] = out
+        return out
+
+    def note_tenant_assigned(self, tenant: str) -> None:
+        with self._tenant_mu:
+            self.tenant_assigned[tenant] = self.tenant_assigned.get(tenant, 0) + 1
+
+    def tenant_task_shares(self) -> Dict[str, int]:
+        """Per-tenant totals of tasks assigned by this scheduler instance —
+        the fairness denominator bench's multi-tenant scenario reports."""
+        with self._tenant_mu:
+            return dict(self.tenant_assigned)
+
+    # -- plan-fingerprint result cache (ISSUE 7) ------------------------------
+    def save_job_fingerprint(self, job_id: str, fingerprint: str) -> None:
+        """Remember which result-cache key a job completes into (and which
+        entry a lost cached result invalidates)."""
+        self.kv.put(self._key("jobfp", job_id), fingerprint.encode())
+
+    def get_job_fingerprint(self, job_id: str) -> Optional[str]:
+        v = self.kv.get(self._key("jobfp", job_id))
+        return v.decode() if v is not None else None
+
+    def result_cache_put(self, fingerprint: str, completed) -> bool:
+        """Best-effort publish of a completed job's result partition
+        locations under resultcache/{fingerprint}. The write passes the
+        `cache.put` chaos site (keyed on the content-derived fingerprint —
+        a plan coordinate, never a job id): a torn write is recorded and
+        SKIPPED, never retried here — the cache is an accelerator, and the
+        job completion that triggered the put stands either way."""
+        from ballista_tpu.utils.chaos import ChaosInjected
+
+        entry = pb.ResultCacheEntry(
+            fingerprint=fingerprint, created_at=time.time()
+        )
+        for pl in completed.partition_location:
+            entry.partition_location.add().CopyFrom(pl)
+        try:
+            if self._chaos is not None:
+                self._chaos.maybe_fail("cache.put", f"fp:{fingerprint[:16]}")
+            self.kv.put(
+                self._key("resultcache", fingerprint),
+                entry.SerializeToString(),
+            )
+        except ChaosInjected:
+            _record_recovery("chaos_injected")
+            _record_tenancy("cache_put_torn")
+            log.warning("result-cache put torn by chaos (fp=%s...)",
+                        fingerprint[:16])
+            return False
+        _record_tenancy("cache_put")
+        return True
+
+    def result_cache_lookup(self, fingerprint: str):
+        """CompletedJob (cached=True) for a live entry, else None.
+
+        Liveness: every executor referenced by the entry must still hold a
+        live lease — the result partitions live in executor work dirs, so
+        an entry naming a dead executor is deleted and reported as a miss
+        (the lazy half of invalidation; the eager half is the
+        ReportLostPartition path for leases that outlive the data)."""
+        key = self._key("resultcache", fingerprint)
+        v = self.kv.get(key)
+        if v is None:
+            _record_tenancy("cache_miss")
+            return None
+        entry = pb.ResultCacheEntry()
+        entry.ParseFromString(v)
+        for eid in {pl.executor_meta.id for pl in entry.partition_location}:
+            if self.get_executor_metadata(eid) is None:
+                self.kv.delete(key)
+                _record_tenancy("cache_invalidated")
+                log.info(
+                    "result-cache entry %s... invalidated (executor %s gone)",
+                    fingerprint[:16], eid,
+                )
+                return None
+        completed = pb.CompletedJob(cached=True)
+        for pl in entry.partition_location:
+            completed.partition_location.add().CopyFrom(pl)
+        _record_tenancy("cache_hit")
+        return completed
+
+    def result_cache_invalidate(self, fingerprint: str) -> None:
+        self.kv.delete(self._key("resultcache", fingerprint))
+        _record_tenancy("cache_invalidated")
 
     # -- stage plans ----------------------------------------------------------
     def stage_job_plan(self, job_id: str, attempt: int = 0) -> JobPlanBatch:
@@ -774,6 +926,58 @@ class SchedulerState:
         return restarted
 
     # -- scheduling ---------------------------------------------------------
+    def _tenant_inflight(self, idx: _TaskIndex) -> Dict[str, int]:
+        """Per-tenant totals of currently RUNNING tasks, via the index's
+        per-stage running sets and the job->tenant map."""
+        out: Dict[str, int] = {}
+        for (job_id, _stage), parts in idx.running.items():
+            if not parts:
+                continue
+            tenant, _prio = self.job_tenant(job_id)
+            out[tenant] = out.get(tenant, 0) + len(parts)
+        return out
+
+    def _tenant_candidate_order(
+        self, idx: _TaskIndex
+    ) -> List[Tuple[str, int]]:
+        """Pending (job, stage) candidates in admission order (ISSUE 7).
+
+        Tenants are visited by weighted fair share — smallest
+        in_flight/weight first (ties by tenant name), so a tenant hogging
+        the cluster yields the next slot to lighter tenants the moment they
+        have runnable work. A tenant at its in-flight quota
+        (ballista.tenant.max_inflight > 0) is skipped entirely: its pending
+        work stays queued until its running tasks drain, which is exactly
+        the starvation bound the quota promises other tenants. Within a
+        tenant, higher-priority jobs come first; the final tie-break is the
+        pre-tenancy (job, str(stage)) KV order, so single-tenant
+        deployments see the EXACT historical candidate order
+        (tests/test_scheduler_state.py asserts identity vs the linear
+        scan)."""
+        quota = self._tenant_quota
+        weights = self._tenant_weights
+        inflight = self._tenant_inflight(idx)
+        by_tenant: Dict[str, List[Tuple[str, int]]] = {}
+        prios: Dict[str, int] = {}
+        for key in idx.pending:
+            tenant, prio = self.job_tenant(key[0])
+            by_tenant.setdefault(tenant, []).append(key)
+            prios[key[0]] = prio
+        order: List[Tuple[str, int]] = []
+        tenant_rank = sorted(
+            by_tenant,
+            key=lambda t: (inflight.get(t, 0) / weights.get(t, 1), t),
+        )
+        for tenant in tenant_rank:
+            if quota > 0 and inflight.get(tenant, 0) >= quota:
+                _record_tenancy("admit_quota_deferred")
+                continue
+            order.extend(sorted(
+                by_tenant[tenant],
+                key=lambda k: (-prios[k[0]], k[0], str(k[1])),
+            ))
+        return order
+
     def assign_next_schedulable_task(
         self, executor_id: str
     ) -> Optional[Tuple[pb.TaskStatus, object]]:
@@ -783,10 +987,12 @@ class SchedulerState:
         task). The per-stage index narrows the work to stages that actually
         have pending tasks, with O(1) upstream-completeness checks; only a
         chosen stage's upstream statuses are read back from the KV (for
-        shuffle locations). Candidate order matches the linear scan's KV
-        key order — tests/test_scheduler_state.py asserts identity on
-        randomized DAGs. Marks the pick Running and returns
-        (status, bound plan)."""
+        shuffle locations). Candidates are visited in weighted fair-share
+        tenant order with per-tenant in-flight quotas (ISSUE 7,
+        _tenant_candidate_order); with no tenants configured this reduces
+        to the linear scan's KV key order — tests/test_scheduler_state.py
+        asserts identity on randomized DAGs. Marks the pick Running and
+        returns (status, bound plan)."""
         idx = self._ensure_task_index()
         # per-task executor blacklist: attempt N+1 must not land on the
         # executor that failed attempt N — unless it is the only executor
@@ -797,11 +1003,7 @@ class SchedulerState:
         # pending tasks of a terminal job must not be handed out (a failed
         # job can leave requeued-then-exhausted pending work behind)
         job_live: Dict[str, bool] = {}
-        # KV keys order stage/partition ids as STRINGS ("10" < "2"); the
-        # scan this replaces inherited that order from get_prefix
-        for job_id, stage_id in sorted(
-            idx.pending, key=lambda k: (k[0], str(k[1]))
-        ):
+        for job_id, stage_id in self._tenant_candidate_order(idx):
             # .get: an earlier iteration's upstream KV refresh may have
             # drained (and dropped) this stage's entry mid-iteration
             parts = idx.pending.get((job_id, stage_id))
@@ -881,6 +1083,19 @@ class SchedulerState:
                     # leave the task for a peer (another partition may still
                     # fit this executor)
                     continue
+                if self._chaos is not None:
+                    # admission chaos (ISSUE 7): abort the PollWork BEFORE
+                    # the Running flip — nothing is written, the executor's
+                    # poll fails transiently and retries, and the rotated
+                    # sequence key gives the retry a fresh verdict. Keyed on
+                    # a per-process admission counter (like kv.put's write
+                    # counter): the seeded verdict SEQUENCE is reproducible,
+                    # while a same-key verdict would refuse this admission
+                    # forever.
+                    self._admit_seq += 1
+                    self._chaos.maybe_fail(
+                        "scheduler.admit", f"admit{self._admit_seq}"
+                    )
                 running = pb.TaskStatus()
                 running.CopyFrom(current)  # keep attempt + history
                 running.running.executor_id = executor_id
@@ -888,6 +1103,7 @@ class SchedulerState:
                 self._ledger_put(
                     (job_id, stage_id, partition), executor_id, running.attempt
                 )
+                self.note_tenant_assigned(self.job_tenant(job_id)[0])
                 return running, bound
         return None
 
@@ -1025,3 +1241,10 @@ class SchedulerState:
         else:
             status.running.SetInParent()
         self.save_job_metadata(job_id, status)
+        if status.WhichOneof("status") == "completed":
+            # publish into the plan-fingerprint result cache (ISSUE 7).
+            # jobfp/{job} exists only when the submission was fingerprintable
+            # AND caching was enabled for it — so this is already gated.
+            fp = self.get_job_fingerprint(job_id)
+            if fp is not None:
+                self.result_cache_put(fp, status.completed)

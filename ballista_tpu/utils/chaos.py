@@ -42,6 +42,15 @@ SITES = (
     "scheduler.crash",       # scheduler hard-death mid-PollWork
                              # (scheduler/server.py) — keyed on the accepted-
                              # status sequence so the crash lands mid-job
+    "cache.put",             # result-cache publish (scheduler/state.py) —
+                             # tears the cache write of a completed job; the
+                             # job still completes (the cache is best-effort)
+                             # and later identical queries just miss
+    "scheduler.admit",       # admission decision (scheduler/state.py
+                             # assignment) — aborts the PollWork handing a
+                             # task out BEFORE the Running flip; the executor
+                             # retries its poll and the next admission draws
+                             # a fresh verdict (rotated sequence key)
 )
 
 _DENOM = float(1 << 64)

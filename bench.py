@@ -475,7 +475,130 @@ def _taxi_rows() -> list[dict]:
     return out
 
 
+def _multitenant_scenario() -> dict | None:
+    """Multi-tenant serving scenario (ISSUE 7): N concurrent tenant clients
+    replay a Zipf-repeated dashboard query mix against ONE standalone
+    cluster (real scheduler gRPC + executors + Flight), reporting p50/p99
+    client latency split by cache hit/miss, the result-cache hit rate, and
+    the per-tenant task-share fairness ratio. Control-plane numbers: the
+    host backend serves the kernels, so this runs (and means the same
+    thing) with or without a reachable device."""
+    import threading
+
+    import numpy as np
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import tenancy_stats
+    from benchmarks.tpch.datagen import generate, is_complete
+
+    n_tenants = int(os.environ.get("BENCH_MT_TENANTS", "4"))
+    replays = int(os.environ.get("BENCH_MT_REPLAYS", "24"))
+    d = REPO / ".bench_cache" / "tpch_mt001"
+    if not is_complete(str(d)):
+        d.parent.mkdir(exist_ok=True)
+        generate(str(d), sf=0.01, parts=2)
+    # the dashboard mix: two real TPC-H shapes + two point-ish aggregates
+    queries = [
+        (QUERIES_DIR / "q1.sql").read_text(),
+        (QUERIES_DIR / "q6.sql").read_text(),
+        "select l_returnflag, count(*) as n from lineitem group by "
+        "l_returnflag order by l_returnflag",
+        "select max(l_extendedprice) as m, min(l_shipdate) as d from lineitem",
+    ]
+    cluster = StandaloneCluster(
+        n_executors=2,
+        config=BallistaConfig({"ballista.tenant.max_inflight": "8"}),
+    )
+    try:
+        tenancy_stats(reset=True)
+        rng = np.random.default_rng(7)
+        schedules = [
+            [int(z - 1) % len(queries) for z in rng.zipf(1.5, size=replays)]
+            for _ in range(n_tenants)
+        ]
+        lat: list[tuple[int, float]] = []  # (query index, seconds)
+        lat_lock = threading.Lock()
+        errors: list = []
+
+        def replay(i: int) -> None:
+            try:
+                from benchmarks.tpch.datagen import register_all
+
+                ctx = BallistaContext(
+                    *cluster.scheduler_addr,
+                    settings={"ballista.tenant.name": f"tenant{i}"},
+                )
+                register_all(ctx, str(d))
+                for qi in schedules[i]:
+                    t0 = time.perf_counter()
+                    out = ctx.sql(queries[qi]).collect()
+                    dt = time.perf_counter() - t0
+                    assert out.num_rows >= 1
+                    with lat_lock:
+                        lat.append((qi, dt))
+                ctx.close()
+            except Exception as e:
+                errors.append(f"tenant{i}: {e}")
+
+        threads = [
+            threading.Thread(target=replay, args=(i,))
+            for i in range(n_tenants)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(600)
+        wall = time.perf_counter() - t0
+        for i, t in enumerate(threads):
+            if t.is_alive():
+                # a hung tenant is a scenario failure: shutting the cluster
+                # down under live submitters (and dividing into an empty
+                # latency list) must not masquerade as a result
+                errors.append(f"tenant{i}: still running after 600s")
+        if errors or not lat:
+            print(f"[multitenant] errors: {errors or ['no latencies']}",
+                  file=sys.stderr)
+            return None
+        stats = tenancy_stats(reset=True)
+        shares = cluster.scheduler_impl.state.tenant_task_shares()
+        secs = sorted(s for _qi, s in lat)
+        hits = stats.get("cache_hit", 0)
+        # every non-hit lookup outcome counts in the denominator, incl.
+        # found-but-invalidated entries (dead executor) and unkeyable plans
+        misses = (stats.get("cache_miss", 0) + stats.get("cache_unkeyable", 0)
+                  + stats.get("cache_invalidated", 0))
+        row = {
+            "tenants": n_tenants,
+            "queries": len(lat),
+            "wall_s": round(wall, 3),
+            "qps": round(len(lat) / wall, 1),
+            "p50_ms": round(1000 * secs[len(secs) // 2], 1),
+            "p99_ms": round(1000 * secs[min(len(secs) - 1,
+                                            int(len(secs) * 0.99))], 1),
+            "cache_hit_rate": round(hits / max(1, hits + misses), 3),
+            "plan_cache_hits": stats.get("plan_cache_hit", 0),
+            "task_share": shares,
+            # fairness: min/max assigned-task share across tenants that got
+            # any (1.0 = perfectly even); cache hits run zero tasks, so
+            # this measures the EXECUTED remainder
+            "fairness_ratio": round(
+                min(shares.values()) / max(shares.values()), 3
+            ) if shares else None,
+        }
+        print(f"[multitenant] {row}", file=sys.stderr)
+        return row
+    finally:
+        cluster.shutdown()
+
+
 def main() -> None:
+    if os.environ.get("BENCH_MULTITENANT_ONLY"):
+        # control-plane scenario only: runs without a reachable device
+        print(json.dumps({"multitenant": _multitenant_scenario()}))
+        return
     _probe_device()
     ensure_data(SF)
     import pyarrow.parquet as pq
@@ -537,6 +660,14 @@ def main() -> None:
         result["ingest"] = headline_ingest
     if headline_readback is not None:
         result["readback"] = headline_readback
+    if time.monotonic() - _T_START <= MAX_SECONDS:
+        try:
+            mt = _multitenant_scenario()
+        except Exception as e:
+            print(f"[multitenant] failed: {e}", file=sys.stderr)
+            mt = None
+        if mt is not None:
+            result["multitenant"] = mt
     try:
         import jax
 

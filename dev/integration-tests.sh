@@ -69,3 +69,14 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_scheduler_restart.py \
     "tests/test_fuzz_device.py::test_fuzz_distributed_two_stage_chaos"
+
+# strict gate on multi-tenant serving (ISSUE 7): weighted fair-share
+# admission with per-tenant in-flight quotas (the starvation bound), the
+# plan-fingerprint result cache (zero-task cache hits, mtime invalidation,
+# restart durability, lost-cached-partition resubmission), chaos-armed
+# cache.put / scheduler.admit staying bit-identical to fault-free, and the
+# concurrent-submission fuzz slice (N tenant clients, Zipf-repeated mix,
+# cache-hit results bit-identical to cold execution).
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_multitenant.py \
+    "tests/test_fuzz_device.py::test_fuzz_concurrent_submission_cache"

@@ -166,11 +166,53 @@ def edit_issue6_scheduler_restart(fdp) -> None:
         m.output_type = ".ballista.ReportLostPartitionResult"
 
 
+def edit_issue7_multitenant(fdp) -> None:
+    """ISSUE 7: multi-tenant serving.
+
+    Adds (all wire-compatible field/message additions):
+    - ExecuteQueryParams.tenant/.priority: the submitting tenant (and its
+      job priority) ride the submission itself, not just the settings map,
+      so admission control keys off a first-class field
+    - JobTenant message: the durable per-job tenant record stored under
+      /ballista/{ns}/tenants/{job} — admission quotas and fairness
+      accounting survive a scheduler restart
+    - ResultCacheEntry message: the plan-fingerprint result cache value
+      stored under /ballista/{ns}/resultcache/{fp} — the completed result
+      partition locations a repeated identical query is served from
+    - CompletedJob.cached: marks a job completed FROM the result cache
+      (zero executor tasks ran), so clients/bench can count hits without
+      scheduler introspection
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+    DBL, BOOL = 1, 8  # FieldDescriptorProto.Type
+
+    eq = msgs["ExecuteQueryParams"]
+    add_field(eq, "tenant", 4, STR)
+    add_field(eq, "priority", 5, U32)
+
+    jt = fdp.message_type.add()
+    jt.name = "JobTenant"
+    add_field(jt, "tenant", 1, STR)
+    add_field(jt, "priority", 2, U32)
+
+    rc = fdp.message_type.add()
+    rc.name = "ResultCacheEntry"
+    add_field(
+        rc, "partition_location", 1, MSG,
+        label=REP, type_name=".ballista.PartitionLocation",
+    )
+    add_field(rc, "created_at", 2, DBL)
+    add_field(rc, "fingerprint", 3, STR)
+
+    add_field(msgs["CompletedJob"], "cached", 2, BOOL)
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
     edit_issue5_orphan_reconcile,
     edit_issue6_scheduler_restart,
+    edit_issue7_multitenant,
 ]
 
 

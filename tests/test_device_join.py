@@ -71,3 +71,132 @@ def test_tpu_backend_join_matches_cpu(tmp_path_factory):
         register_all(ctx, d)
         out[backend] = ctx.sql(_tpch_join_sql()).collect().to_pylist()
     assert out["cpu"] == out["tpu"]
+
+
+# ---------------------------------------------------------------------------
+# membership counting (ISSUE 7 satellite: the q13/q22 device path)
+# ---------------------------------------------------------------------------
+
+
+def test_device_membership_counts_matches_host_oracle():
+    """The counts-only plane: per-probe run-lengths bit-equal to the host
+    join_indices counts, nulls (code -1) on both sides included."""
+    from ballista_tpu.ops.join import device_membership_counts
+    from ballista_tpu.physical.joinutil import join_indices
+
+    rng = np.random.default_rng(11)
+    build = rng.integers(0, 40, 300).astype(np.int64)
+    build[rng.integers(0, 300, 20)] = -1  # null build keys never match
+    probe = rng.integers(0, 60, 500).astype(np.int64)
+    probe[rng.integers(0, 500, 30)] = -1
+    counts = device_membership_counts(build, probe)
+    assert counts is not None
+    # host oracle counts via the inner join's probe_idx multiplicities
+    _b, p = join_indices(build, probe, "inner")
+    want = np.bincount(p, minlength=len(probe)) if len(p) else np.zeros(len(probe), int)
+    assert counts.tolist() == want.tolist()
+    assert all(counts[probe < 0] == 0)
+
+
+def _both_backends(tables, sql):
+    out = {}
+    for backend in ("cpu", "tpu"):
+        ctx = ExecutionContext(BallistaConfig({"ballista.executor.backend": backend}))
+        for name, t in tables.items():
+            ctx.register_record_batches(name, t, n_partitions=1)
+        out[backend] = ctx.sql(sql).collect().to_pylist()
+    return out
+
+
+def _count_join_tables(with_nulls=False):
+    rng = np.random.default_rng(23)
+    n_c, n_o = 200, 1500
+    cust = pa.table({
+        "c_id": pa.array(np.arange(n_c), type=pa.int64()),
+        "c_grp": pa.array(rng.integers(0, 9, n_c), type=pa.int64()),
+    })
+    oid = rng.integers(0, 5000, n_o)
+    okey = rng.integers(0, int(n_c * 1.3), n_o)  # some point past customers
+    orders = {
+        "o_id": pa.array(oid, type=pa.int64()),
+        "o_cust": pa.array(okey, type=pa.int64()),
+    }
+    if with_nulls:
+        # nulls in the COUNTED column (COUNT must skip them) and in the
+        # join key (never matches)
+        null_at = rng.random(n_o) < 0.15
+        orders["o_id"] = pa.array(
+            [None if m else int(v) for v, m in zip(oid, null_at)],
+            type=pa.int64(),
+        )
+        key_null = rng.random(n_o) < 0.1
+        orders["o_cust"] = pa.array(
+            [None if m else int(v) for v, m in zip(okey, key_null)],
+            type=pa.int64(),
+        )
+    return {"cust": cust, "orders": pa.table(orders)}
+
+
+@pytest.mark.parametrize("with_nulls", [False, True])
+def test_count_over_left_join_device_matches_cpu(with_nulls):
+    """q13's shape: COUNT(right column) grouped by left keys over a LEFT
+    join routes through the per-probe counts plane — tpu == cpu
+    bit-equality (counts are exact ints), including NULL counted values
+    and NULL join keys."""
+    from ballista_tpu.utils import tracing
+
+    sql = (
+        "select c_grp, cnt, count(*) as dist from ("
+        "  select c_id, c_grp, count(o_id) as cnt from cust "
+        "  left outer join orders on c_id = o_cust group by c_id, c_grp"
+        ") sub group by c_grp, cnt order by c_grp, cnt"
+    )
+    tracing.reset()
+    out = _both_backends(_count_join_tables(with_nulls), sql)
+    assert out["cpu"] == out["tpu"]
+    assert tracing.counters().get("device.count_join", 0) >= 1
+
+
+def test_anti_join_membership_device_matches_cpu():
+    """q22's NOT EXISTS: the ANTI join keeps rows off counts == 0 on
+    device, bit-identical to the host anti_right selection."""
+    from ballista_tpu.ops.runtime import join_path_stats
+
+    tables = _count_join_tables()
+    sql = (
+        "select c_grp, count(*) as n from cust where not exists ("
+        "  select * from orders where o_cust = c_id"
+        ") group by c_grp order by c_grp"
+    )
+    join_path_stats(reset=True)
+    out = _both_backends(tables, sql)
+    assert out["cpu"] == out["tpu"]
+    assert join_path_stats(reset=True).get("paths", {}).get("device", 0) >= 1
+
+
+def test_q13_q22_device_engaged_on_tpch(tmp_path_factory):
+    """The ROADMAP carry-over struck for real: q13 and q22 run their
+    membership counting on the device path (counter-asserted) and stay
+    bit-identical to the cpu backend on real TPC-H data."""
+    import pathlib
+
+    from benchmarks.tpch.datagen import generate, register_all
+    from ballista_tpu.utils import tracing
+
+    d = str(tmp_path_factory.mktemp("tpch_q13"))
+    generate(d, sf=0.002, parts=2)
+    qdir = pathlib.Path(__file__).parent.parent / "benchmarks" / "tpch" / "queries"
+    out = {}
+    for backend in ("cpu", "tpu"):
+        ctx = ExecutionContext(BallistaConfig({"ballista.executor.backend": backend}))
+        register_all(ctx, d)
+        tracing.reset()
+        out[backend] = {
+            q: ctx.sql((qdir / f"{q}.sql").read_text()).collect().to_pylist()
+            for q in ("q13", "q22")
+        }
+        if backend == "tpu":
+            assert tracing.counters().get("device.count_join", 0) >= 1
+    # counts are ints and q22's sum is exact over these rows: bit-equality
+    assert out["cpu"]["q13"] == out["tpu"]["q13"]
+    assert out["cpu"]["q22"] == out["tpu"]["q22"]

@@ -427,6 +427,92 @@ def test_fuzz_distributed_two_stage_chaos(seed):
     assert stats.get("chaos_injected", 0) > 0, stats
 
 
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_concurrent_submission_cache(seed):
+    """Multi-tenant fuzz slice (ISSUE 7 satellite): N concurrent tenant
+    clients replay a Zipf-repeated random query mix against ONE cluster
+    with the result cache armed; every result — cache-served or cold —
+    must be bit-identical to a cache-disabled sequential baseline, and the
+    Zipf repetition must actually produce hits. Own rng streams (16000+
+    data, 17000+ queries/replay), so every baseline stream above stays
+    byte-identical."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import tenancy_stats
+
+    rng = np.random.default_rng(16000 + seed)
+    qrng = np.random.default_rng(17000 + seed)
+    _fresh()
+    n = int(rng.integers(2_000, 6_000))
+    table = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 40, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+            "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+            "s": pa.array([f"t{x}" for x in rng.integers(0, 5, n)]),
+        }
+    )
+    queries = _distributed_fuzz_queries(qrng, k=4)
+    # Zipf-repeated replay schedules, drawn BEFORE any threading so the
+    # schedule is a pure function of the seed
+    n_tenants = 4
+    schedules = [
+        [int(z - 1) % len(queries)
+         for z in qrng.zipf(1.6, size=int(qrng.integers(4, 7)))]
+        for _ in range(n_tenants)
+    ]
+    cold = _run_distributed(
+        table, queries,
+        {"ballista.cache.results": "false", "ballista.shuffle.partitions": "4"},
+    )
+    cluster = StandaloneCluster(n_executors=2)
+    try:
+        tenancy_stats(reset=True)
+        results = {}
+        errors = []
+
+        def replay(i):
+            try:
+                ctx = BallistaContext(
+                    *cluster.scheduler_addr,
+                    settings={
+                        "ballista.tenant.name": f"tenant{i}",
+                        "ballista.shuffle.partitions": "4",
+                    },
+                )
+                ctx.register_record_batches("t", table, n_partitions=4)
+                results[i] = [
+                    (qi, ctx.sql(queries[qi]).collect())
+                    for qi in schedules[i]
+                ]
+                ctx.close()
+            except Exception as e:  # surface in the main thread
+                errors.append((i, e))
+
+        import threading
+
+        threads = [
+            threading.Thread(target=replay, args=(i,))
+            for i in range(n_tenants)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert not errors, errors
+        for i in range(n_tenants):
+            for qi, got in results[i]:
+                assert got.equals(cold[qi]), (
+                    i, queries[qi], got.to_pydict(), cold[qi].to_pydict()
+                )
+        stats = tenancy_stats(reset=True)
+        total = sum(len(s) for s in schedules)
+        assert stats.get("cache_hit", 0) > 0, (stats, schedules)
+        assert stats.get("cache_hit", 0) + stats.get("cache_miss", 0) >= total
+    finally:
+        cluster.shutdown()
+
+
 @pytest.mark.parametrize("seed", range(4))
 def test_fuzz_float_extrema_minmax(tmp_path, seed):
     """Dedicated float-extrema sweep: MIN/MAX over NaN/±0/subnormal/

@@ -146,6 +146,24 @@ def test_failure_rule_scheduler_site_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_tenancy_site_fixture_pair():
+    """ISSUE 7 satellite: the new cache.put / scheduler.admit sites are
+    registered — unregistered cache sites and computed admission site names
+    in the tenancy code fail lint; the registered-literal shapes are clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_tenancy_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "cache.write" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_tenancy_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_failure_rule_sites_track_chaos_registry():
     """The rule reads SITES from ballista_tpu/utils/chaos.py, so the two
     can't drift silently."""
