@@ -32,6 +32,10 @@ from ballista_tpu.scheduler.rpc import SchedulerGrpcClient
 from ballista_tpu.serde.logical import plan_to_proto
 
 POLL_INTERVAL = 0.1  # ref context.rs:195
+# status polls start here and double toward POLL_INTERVAL (ISSUE 8): a
+# small query completing in a few ms should not pay a fixed 100ms poll
+# gap, while long jobs converge to the reference cadence within 5 polls
+POLL_INTERVAL_MIN = 0.005
 
 
 class _CachedResultLost(BallistaError):
@@ -125,6 +129,179 @@ class BallistaContext(ExecutionContext):
         params.priority = self.config.tenant_priority()
         return self._client.execute_query(params).job_id
 
+    def collect_stream(self, plan: lp.LogicalPlan, timeout: float = 300.0):
+        """Streaming collect (ISSUE 8): yield result RecordBatches in
+        final-partition order, starting as soon as the FIRST final-stage
+        partition completes (per-partition completion notifications on the
+        running job status) instead of after the whole job. Batches are
+        committed per partition — a mid-stream fetch loss discards that
+        partition's partial batches and routes through ReportLostPartition
+        + re-poll, so everything yielded is final. The concatenation of the
+        yielded batches is bit-identical to collect()'s table (pre-cast).
+
+        A cache-served job whose partitions died is resubmitted ONCE, like
+        collect() — but only while nothing has been yielded yet (yielded
+        batches cannot be retracted)."""
+        job_id = self.submit(plan)
+        yielded = False
+        try:
+            for batch in self._stream_results(job_id, plan.schema(), timeout):
+                yielded = True
+                yield batch
+        except _CachedResultLost as e:
+            if yielded:
+                raise ExecutionError(
+                    f"job {e.job_id}: cached result partitions lost "
+                    "mid-stream — retry the query"
+                ) from e
+            from ballista_tpu.ops.runtime import record_tenancy
+
+            record_tenancy("cache_lost_resubmitted")
+            job_id = self.submit(plan)
+            try:
+                yield from self._stream_results(job_id, plan.schema(), timeout)
+            except _CachedResultLost as e2:
+                raise ExecutionError(
+                    f"job {e2.job_id}: cached result partitions lost twice "
+                    "in a row (executor churn outpacing cache "
+                    "invalidation) — retry the query"
+                ) from e2
+
+    def _stream_results(self, job_id: str, schema, timeout: float = 300.0):
+        """Poll the job status; fetch each final-stage partition the moment
+        its completion is published (running.partial_location while the job
+        runs, completed.partition_location at the end) and yield its
+        batches once the whole partition streamed cleanly, in partition
+        order. Fetch failures — including mid-stream drops after the first
+        batch — discard the partition's uncommitted batches and report the
+        lost location (ReportLostPartition), exactly like the buffered
+        path: a restarted job re-polls for fresh locations; a dead cached
+        entry surfaces _CachedResultLost for the caller's resubmission."""
+        from ballista_tpu.errors import ShuffleFetchError
+        from ballista_tpu.ops.runtime import record_recovery, record_serving
+
+        deadline = time.time() + timeout
+        committed: Dict[int, list] = {}  # partition -> batches (not yet yielded)
+        done: set = set()  # partitions committed (incl. already yielded)
+        # partition -> ((executor id, path), failure time) of a location
+        # that already failed + was reported: re-fetching the identical
+        # location before the scheduler publishes a fresh one would just
+        # spin. Cooldown-based, not until-it-changes: a recompute can
+        # legitimately land on the same executor AND path (sole survivor).
+        failed_locs: Dict[int, tuple] = {}
+        FAILED_LOC_COOLDOWN = 0.5
+        next_yield = 0
+        interval = POLL_INTERVAL_MIN
+        while True:
+            if time.time() > deadline:
+                raise ExecutionError(f"job {job_id} timed out after {timeout}s")
+            status = self._client.get_job_status(
+                pb.GetJobStatusParams(job_id=job_id)
+            ).status
+            which = status.WhichOneof("status")
+            if which == "failed":
+                raise ExecutionError(f"job {job_id} failed: {status.failed.error}")
+            total = None
+            if which == "completed":
+                locs = list(status.completed.partition_location)
+                total = len(locs)
+            elif which == "running":
+                locs = list(status.running.partial_location)
+            else:
+                locs = []
+            for loc in locs:
+                p = loc.partition_id.partition_id
+                sig = (loc.executor_meta.id, loc.path)
+                if p in done:
+                    continue
+                prior = failed_locs.get(p)
+                if (
+                    prior is not None
+                    and prior[0] == sig
+                    and time.time() - prior[1] < FAILED_LOC_COOLDOWN
+                ):
+                    # a known-dead location the scheduler has not replaced
+                    # yet (a stale status snapshot can republish it for a
+                    # few polls); retried after the cooldown either way
+                    continue
+                try:
+                    batches = self._fetch_partition_batches(loc)
+                except ShuffleFetchError as e:
+                    result = self._client.report_lost_partition(
+                        pb.ReportLostPartitionParams(
+                            job_id=job_id,
+                            executor_id=e.executor_id,
+                            stage_id=e.stage_id,
+                            partition_id=e.map_partition,
+                            path=e.path,
+                        )
+                    )
+                    if not result.restarted:
+                        if which == "completed" and status.completed.cached:
+                            raise _CachedResultLost(job_id) from e
+                        raise
+                    record_recovery("result_fetch_restarted")
+                    # keep fetching the OTHER listed partitions this round
+                    # (one dead location must not starve the rest); this
+                    # one retries after the cooldown / on a fresh location
+                    failed_locs[p] = (sig, time.time())
+                    continue
+                failed_locs.pop(p, None)
+                committed[p] = batches
+                done.add(p)
+                if which == "running":
+                    record_serving("stream_partition_early")
+            while next_yield in committed:
+                for batch in committed.pop(next_yield):
+                    yield batch
+                next_yield += 1
+            if total is not None and next_yield >= total:
+                return
+            time.sleep(interval)
+            interval = min(interval * 2, POLL_INTERVAL)
+
+    def _fetch_partition_batches(self, loc: pb.PartitionLocation) -> list:
+        """One result partition as a committed batch list, streamed over
+        Flight (client/flight.py stream_action). Any failure — connect,
+        first byte, or mid-stream — surfaces as ShuffleFetchError naming
+        the lost location; partial batches are dropped by the caller."""
+        from ballista_tpu.client.flight import BallistaClient
+        from ballista_tpu.errors import RpcError, ShuffleFetchError
+
+        action = pb.Action()
+        action.fetch_partition.path = os.path.join(loc.path, "0.arrow")
+        try:
+            client = BallistaClient(
+                loc.executor_meta.host,
+                loc.executor_meta.port,
+                retries=self.config.rpc_retries(),
+                backoff_s=self.config.rpc_backoff_s(),
+            )
+        except Exception as e:
+            raise ShuffleFetchError(
+                f"result partition unreachable: {e}",
+                executor_id=loc.executor_meta.id,
+                host=loc.executor_meta.host,
+                port=loc.executor_meta.port,
+                path=loc.path,
+                stage_id=loc.partition_id.stage_id,
+                map_partition=loc.partition_id.partition_id,
+            ) from e
+        try:
+            return list(client.stream_action(action))
+        except RpcError as e:
+            raise ShuffleFetchError(
+                f"result partition fetch failed: {e}",
+                executor_id=loc.executor_meta.id,
+                host=loc.executor_meta.host,
+                port=loc.executor_meta.port,
+                path=loc.path,
+                stage_id=loc.partition_id.stage_id,
+                map_partition=loc.partition_id.partition_id,
+            ) from e
+        finally:
+            client.close()
+
     def _collect_results(
         self, job_id: str, schema, timeout: float = 300.0
     ) -> pa.Table:
@@ -135,8 +312,21 @@ class BallistaContext(ExecutionContext):
         is reported back via ReportLostPartition: the scheduler requeues
         the lost final-stage tasks through lineage and flips the job back
         to running, and this loop re-polls for the fresh locations instead
-        of erroring (ISSUE 6 / PR 5 residue)."""
+        of erroring (ISSUE 6 / PR 5 residue).
+
+        With ballista.client.stream_results on, the same contract runs in
+        STREAMING mode: partitions are fetched as they complete and the
+        table assembles from the streamed batches — bit-identical to the
+        buffered result."""
         from ballista_tpu.errors import ShuffleFetchError
+
+        if self.config.stream_results():
+            batches = list(self._stream_results(job_id, schema, timeout))
+            if not batches:
+                return schema.empty_table()
+            return pa.Table.from_batches(
+                batches, schema=batches[0].schema
+            ).cast(schema)
 
         deadline = time.time() + timeout
         while True:
@@ -175,6 +365,7 @@ class BallistaContext(ExecutionContext):
 
     def _wait_for_job(self, job_id: str, timeout: float) -> pb.JobStatus:
         deadline = time.time() + timeout
+        interval = POLL_INTERVAL_MIN
         while time.time() < deadline:
             result = self._client.get_job_status(pb.GetJobStatusParams(job_id=job_id))
             status = result.status
@@ -183,7 +374,8 @@ class BallistaContext(ExecutionContext):
                 return status
             if which == "failed":
                 raise ExecutionError(f"job {job_id} failed: {status.failed.error}")
-            time.sleep(POLL_INTERVAL)
+            time.sleep(interval)
+            interval = min(interval * 2, POLL_INTERVAL)
         raise ExecutionError(f"job {job_id} timed out after {timeout}s")
 
     def _fetch_partition(self, loc: pb.PartitionLocation) -> pa.Table:

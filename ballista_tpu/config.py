@@ -108,10 +108,49 @@ BALLISTA_TENANT_WEIGHTS = "ballista.tenant.weights"
 # input file mtimes + result-affecting settings); a repeated identical
 # query over unchanged inputs completes instantly with ZERO executor tasks.
 BALLISTA_RESULT_CACHE = "ballista.cache.results"
+# result-cache bounds (ISSUE 8): max live resultcache/{fp} entries (0 =
+# unbounded; past the cap the least-recently-HIT entries are deleted from
+# the KV) and a TTL in seconds (0 = no expiry; an entry older than this is
+# treated as a miss and deleted on lookup). Entries are location-only and
+# tiny, but an unbounded long-lived scheduler would accumulate every
+# distinct query it ever served.
+BALLISTA_RESULT_CACHE_MAX_ENTRIES = "ballista.cache.results.max_entries"
+BALLISTA_RESULT_CACHE_TTL_S = "ballista.cache.results.ttl_s"
 # cross-job physical-plan sharing (scheduler-side): optimize+physical
 # planning output is content-keyed (fingerprint sans mtimes), so N tenants
 # submitting the same dashboard query plan it once.
 BALLISTA_PLAN_CACHE = "ballista.cache.plans"
+# -- low-latency serving tier (ISSUE 8) -------------------------------------
+# push-based task dispatch: executors open a server-streaming SubscribeWork
+# stream and the scheduler pushes TaskDefinitions the moment assignment
+# picks them. The PollWork loop stays as heartbeat + automatic dispatch
+# fallback when the stream is down. Governs BOTH sides: an executor with it
+# off never subscribes, a scheduler with it off refuses subscriptions.
+BALLISTA_PUSH_DISPATCH = "ballista.executor.push_dispatch"
+# adaptive idle poll backoff: while the push stream is healthy the PollWork
+# heartbeat interval decays from 250ms toward this ceiling (seconds) and
+# snaps back to 250ms the moment the stream drops — the steady-state RPC
+# load of a large idle fleet falls ~8x without touching crash-tolerance
+# semantics (the echo/lease machinery rides whatever polls happen).
+BALLISTA_IDLE_POLL_MAX_S = "ballista.executor.idle_poll_max_s"
+# persistent compiled-program (AOT) cache directory beside the layout
+# cache: jitted device-stage programs are exported (jax.export), serialized
+# to disk keyed on stage identity + shape bucket + jax/jaxlib/backend
+# fingerprint, and reloaded by later processes — a warm disk tier under the
+# in-memory jit cache, so a cold executor skips the Python trace (and, with
+# the persistent XLA cache, the compile). "" disables.
+BALLISTA_TPU_AOT_CACHE_DIR = "ballista.tpu.aot_cache"
+# pre-warm at executor start: load every manifest entry of the AOT cache
+# and compile it BEFORE the first task arrives, so a cold executor's first
+# small query pays zero trace/compile. Off by default — interactive/test
+# processes should not pay a bulk warm-up they may never amortize.
+BALLISTA_TPU_PREWARM = "ballista.tpu.prewarm"
+# client-side streaming result fetch: collect() starts fetching (and
+# consuming) final-stage result partitions AS THEY COMPLETE, via the
+# per-partition completion notifications on the running job status, instead
+# of waiting for the whole job — time-to-first-batch drops to the first
+# partition's latency. Results are bit-identical to the buffered path.
+BALLISTA_STREAM_RESULTS = "ballista.client.stream_results"
 # -- deterministic fault injection (utils/chaos.py) -------------------------
 # rate > 0 arms the registered injection sites; each (site, key) pair draws
 # a DETERMINISTIC verdict from sha256(seed, site, key), so a chaos run is
@@ -163,7 +202,16 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TENANT_MAX_INFLIGHT: "0",
     BALLISTA_TENANT_WEIGHTS: "",
     BALLISTA_RESULT_CACHE: "true",
+    BALLISTA_RESULT_CACHE_MAX_ENTRIES: "1024",
+    BALLISTA_RESULT_CACHE_TTL_S: "0",
     BALLISTA_PLAN_CACHE: "true",
+    BALLISTA_PUSH_DISPATCH: "true",
+    BALLISTA_IDLE_POLL_MAX_S: "2",
+    # cwd-relative beside the layout cache (same rationale: warm starts
+    # survive process restarts without writing outside the working tree)
+    BALLISTA_TPU_AOT_CACHE_DIR: ".ballista_cache/aot",
+    BALLISTA_TPU_PREWARM: "false",
+    BALLISTA_STREAM_RESULTS: "false",
     BALLISTA_RPC_RETRIES: "3",
     BALLISTA_RPC_BACKOFF_MS: "50",
     BALLISTA_CHAOS_SEED: "0",
@@ -305,8 +353,40 @@ class BallistaConfig(Mapping[str, str]):
     def result_cache(self) -> bool:
         return self._settings[BALLISTA_RESULT_CACHE].lower() in ("1", "true", "yes")
 
+    def result_cache_max_entries(self) -> int:
+        """Live result-cache entry cap (0 = unbounded)."""
+        return max(0, int(self._settings[BALLISTA_RESULT_CACHE_MAX_ENTRIES]))
+
+    def result_cache_ttl_s(self) -> float:
+        """Result-cache entry time-to-live in seconds (0 = no expiry)."""
+        return max(0.0, float(self._settings[BALLISTA_RESULT_CACHE_TTL_S]))
+
     def plan_cache(self) -> bool:
         return self._settings[BALLISTA_PLAN_CACHE].lower() in ("1", "true", "yes")
+
+    def push_dispatch(self) -> bool:
+        """Push-based task dispatch over SubscribeWork (ISSUE 8)."""
+        return self._settings[BALLISTA_PUSH_DISPATCH].lower() in ("1", "true", "yes")
+
+    def idle_poll_max_s(self) -> float:
+        """Ceiling of the adaptive idle-poll backoff while the push stream
+        is healthy; the floor is the 250ms reference interval."""
+        return max(0.25, float(self._settings[BALLISTA_IDLE_POLL_MAX_S]))
+
+    def tpu_aot_cache_dir(self) -> str:
+        """Expanded AOT program-cache directory; "" = disabled."""
+        import os
+
+        d = self._settings[BALLISTA_TPU_AOT_CACHE_DIR].strip()
+        return os.path.expanduser(d) if d else ""
+
+    def tpu_prewarm(self) -> bool:
+        """Load + compile every AOT-cache manifest entry at executor start."""
+        return self._settings[BALLISTA_TPU_PREWARM].lower() in ("1", "true", "yes")
+
+    def stream_results(self) -> bool:
+        """Client-side streaming result fetch (ISSUE 8)."""
+        return self._settings[BALLISTA_STREAM_RESULTS].lower() in ("1", "true", "yes")
 
     def rpc_retries(self) -> int:
         """Transient-RPC retry attempts beyond the first call."""

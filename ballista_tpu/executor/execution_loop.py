@@ -1,4 +1,4 @@
-"""Executor poll loop + task execution.
+"""Executor poll loop + push-subscribe loop + task execution.
 
 The reference's pull model (rust/executor/src/execution_loop.rs): every 250ms
 the executor calls PollWork with its metadata, whether it can accept a task,
@@ -6,6 +6,18 @@ and the statuses of tasks that finished since the last poll (heartbeat and
 work queue in one RPC). Returned TaskDefinitions are decoded and run on a
 bounded task pool; results become Completed/Failed statuses pushed on the
 next poll (ref as_task_status, execution_loop.rs:112-140).
+
+The 250ms poll was a POC simplification (PAPER.md: "proof-of-concept"); at
+serving QPS it puts half a poll interval of dead time in front of every
+task. ISSUE 8 adds the push path: the executor opens ONE server-streaming
+SubscribeWork stream and the scheduler pushes TaskDefinitions the moment
+assignment picks them. The poll loop stays — as the heartbeat (statuses,
+lease refresh, running_echo for ledger reconciliation) and as the AUTOMATIC
+dispatch fallback: while the stream is healthy polls say
+can_accept_task=False and their interval decays toward
+ballista.executor.idle_poll_max_s; the moment the stream drops, the
+interval snaps back to 250ms and polls pull work again, until the
+re-subscribe (jittered backoff) succeeds.
 
 Unlike the reference, task execution happens in-process rather than through
 a loopback Flight call to the executor's own data plane
@@ -79,6 +91,20 @@ class PollLoop:
         # attempt's vouch (ISSUE 6).
         self._inflight_mu = threading.Lock()
         self._inflight: dict = {}  # (job, stage, part) -> (PartitionId, attempt); guarded-by: self._inflight_mu
+        # -- push dispatch (ISSUE 8) ------------------------------------
+        self._push_enabled = self.config.push_dispatch()
+        self._idle_poll_max = self.config.idle_poll_max_s()
+        # set while the SubscribeWork stream is live: polls become pure
+        # heartbeats (can_accept_task=False) and their interval decays
+        self._stream_ok = threading.Event()
+        self._subscribe_thread: Optional[threading.Thread] = None  # guarded-by: self._mu
+        self._push_call = None  # live stream call, for cancel; guarded-by: self._mu
+        self._poll_interval = POLL_INTERVAL_SECS  # guarded-by: self._mu
+        # kicks the poll loop out of a decayed idle wait: a finishing task
+        # must deliver its status NOW (job completion latency), and a
+        # dropped stream must start fallback polling NOW — the backoff only
+        # ever delays true idle heartbeats
+        self._wake = threading.Event()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -86,13 +112,35 @@ class PollLoop:
         with self._mu:
             self._thread = t
         t.start()
+        if self._push_enabled:
+            st = threading.Thread(target=self._subscribe_loop, daemon=True)
+            with self._mu:
+                self._subscribe_thread = st
+            st.start()
+
+    def _cancel_push(self) -> None:
+        """Tear down the live push stream (stop/death): cancelling the call
+        unblocks the subscribe thread AND lets the scheduler's stream
+        generator observe the disconnect and unregister the subscriber."""
+        with self._mu:
+            call = self._push_call
+        if call is not None:
+            try:
+                call.cancel()
+            except Exception:
+                pass
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
+        self._cancel_push()
         with self._mu:
             t = self._thread
+            st = self._subscribe_thread
         if t:
             t.join(timeout=5)
+        if st:
+            st.join(timeout=5)
 
     def run(self) -> None:
         while not self._stop.is_set():
@@ -109,6 +157,9 @@ class PollLoop:
                     self.metadata.id, self._poll_n,
                 )
                 self._stop.set()
+                # a dead process's streams die with it: cancel so the
+                # scheduler unregisters the subscriber and stops pushing
+                self._cancel_push()
                 if self.on_death is not None:
                     try:
                         self.on_death()
@@ -129,7 +180,26 @@ class PollLoop:
                     self.gc_work_dir()
                 except Exception as e:
                     log.warning("work-dir GC failed: %s", e)
-            self._stop.wait(POLL_INTERVAL_SECS)
+            # adaptive idle backoff (ISSUE 8): while the push stream is
+            # healthy the heartbeat decays toward the configured ceiling —
+            # the steady-state PollWork load of an idle fleet collapses
+            # without touching dispatch latency (push owns dispatch) or
+            # crash tolerance (echo/lease ride whatever polls happen). The
+            # subscribe loop snaps the interval back on stream loss.
+            if self._stream_ok.is_set():
+                with self._mu:
+                    self._poll_interval = min(
+                        self._poll_interval * 2.0, self._idle_poll_max
+                    )
+                    interval = self._poll_interval
+            else:
+                with self._mu:
+                    self._poll_interval = POLL_INTERVAL_SECS
+                    interval = POLL_INTERVAL_SECS
+            if self._wake.wait(interval):
+                self._wake.clear()
+                with self._mu:
+                    self._poll_interval = POLL_INTERVAL_SECS
 
     def gc_work_dir(self) -> int:
         """Delete shuffle dirs for jobs idle longer than shuffle_ttl_seconds."""
@@ -168,8 +238,18 @@ class PollLoop:
         blocking-reacquire was a TOCTOU: concurrent completions between the
         probe and the reacquire could leave the poll thread BLOCKED on the
         semaphore, stopping heartbeats until a slot freed — long enough and
-        a healthy executor got its lease lapsed and its tasks reset.)"""
-        slot_held = self._available.acquire(blocking=False)
+        a healthy executor got its lease lapsed and its tasks reset.)
+
+        While the push stream is healthy this poll is a pure heartbeat:
+        can_accept_task=False (dispatch belongs to the push path, and the
+        latency harness asserts a healthy push cluster runs with ZERO
+        poll-dispatched tasks); the moment the stream drops, polls pull
+        work again — that IS the fallback."""
+        slot_held = (
+            False
+            if self._stream_ok.is_set()
+            else self._available.acquire(blocking=False)
+        )
         # snapshot in-flight BEFORE draining statuses: a task finishing in
         # between is then reported as running (its status follows next
         # poll) rather than as neither — "neither" would read as an
@@ -221,6 +301,68 @@ class PollLoop:
         if slot_held:
             self._available.release()
         return False
+
+    # -- push dispatch (ISSUE 8) ----------------------------------------
+    def _subscribe_loop(self) -> None:
+        """Keep ONE SubscribeWork stream open; run pushed tasks; on any
+        drop, mark the stream unhealthy (polls snap back to 250ms and pull
+        work — the automatic fallback) and re-subscribe with jittered
+        backoff. A scheduler with push disabled answers UNIMPLEMENTED —
+        still just a failed subscription here; the executor keeps probing
+        at the backoff cap, so flipping the scheduler's config (or a
+        rolling upgrade) picks the stream back up without a restart."""
+        from ballista_tpu.ops.runtime import record_serving
+        from ballista_tpu.scheduler.rpc import backoff_delay
+
+        failures = 0
+        while not self._stop.is_set():
+            params = pb.SubscribeWorkParams(slots=self.concurrent_tasks)
+            params.metadata.CopyFrom(self.metadata)
+            was_up = False
+            try:
+                call = self.scheduler.subscribe_work(params)
+                with self._mu:
+                    self._push_call = call
+                # optimistic health: a refused/unreachable stream raises on
+                # the first iteration below, within one scheduler tick
+                self._stream_ok.set()
+                was_up = True
+                record_serving("push_subscribed")
+                failures = 0
+                for td in call:
+                    self._on_pushed_task(td)
+            except Exception as e:
+                if not self._stop.is_set():
+                    log.info("push stream down: %s", e)
+            finally:
+                self._stream_ok.clear()
+                with self._mu:
+                    self._push_call = None
+                    self._poll_interval = POLL_INTERVAL_SECS
+                if was_up:
+                    record_serving("push_stream_drop")
+                self._wake.set()  # fallback polling starts NOW
+            if self._stop.is_set():
+                return
+            failures += 1
+            self._stop.wait(backoff_delay(failures - 1, 0.05, cap=2.0))
+
+    def _on_pushed_task(self, task: pb.TaskDefinition) -> None:
+        """One pushed TaskDefinition: exactly the poll-receive path, minus
+        the held slot — the task thread blocks for its semaphore slot
+        itself (the scheduler's credit keeps pushes ≈ slots; a transient
+        overrun just queues on the semaphore, never drops work)."""
+        from ballista_tpu.ops.runtime import record_serving
+
+        pid = task.task_id
+        with self._inflight_mu:
+            self._inflight[(pid.job_id, pid.stage_id, pid.partition_id)] = (
+                pid, task.attempt,
+            )
+        record_serving("task_pushed")
+        threading.Thread(
+            target=self._run_task, args=(task, False), daemon=True
+        ).start()
 
     def _run_task(self, task: pb.TaskDefinition, slot_held: bool = True) -> None:
         from ballista_tpu.errors import ShuffleFetchError
@@ -324,3 +466,6 @@ class PollLoop:
             self._inflight.pop(
                 (pid.job_id, pid.stage_id, pid.partition_id), None
             )
+        # kick the poll loop out of any decayed idle wait: the status (and
+        # with it job completion) must not ride a multi-second heartbeat
+        self._wake.set()

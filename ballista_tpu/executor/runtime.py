@@ -81,6 +81,16 @@ class BallistaExecutor:
         )
 
     def start(self) -> None:
+        if self.config.tpu_prewarm():
+            # AOT pre-warm BEFORE serving (ISSUE 8): compile every persisted
+            # program so the first small query pays zero trace/compile. A
+            # stale cache must never block executor start.
+            from ballista_tpu.ops import aotcache
+
+            try:
+                aotcache.prewarm(self.config)
+            except Exception as e:
+                log.warning("aot prewarm failed: %s", e)
         self._flight_thread.start()
         self.poll_loop.start()
         log.info("executor %s serving flight on port %s", self.id, self.port)
@@ -137,6 +147,10 @@ class StandaloneCluster:
         # fence the old instance FIRST: its still-running planning threads
         # must not publish into the store the successor is recovering
         old.crashed = True
+        # unblock the push-stream generators NOW (sentinel close) so the
+        # stop below drains without waiting out their 0.25s tick — the gap
+        # must stay inside retrying clients' backoff budget
+        old.close_push_streams()
         # wait for the listening socket to actually close before rebinding
         # the same port (so_reuseport is not guaranteed everywhere)
         self.grpc_server.stop(grace=None).wait()
@@ -150,4 +164,5 @@ class StandaloneCluster:
     def shutdown(self) -> None:
         for ex in self.executors:
             ex.stop()
+        self.scheduler_impl.close_push_streams()
         self.grpc_server.stop(grace=None)

@@ -149,6 +149,12 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     from ballista_tpu.ops.stage import FusedAggregateStage
 
     _configure_jax_cache()
+    # AOT program-cache wiring (ISSUE 8): bind the disk tier's directory +
+    # chaos injector from this dispatch's config so the stage steps built
+    # below resolve through it
+    from ballista_tpu.ops import aotcache
+
+    aotcache.configure(ctx.config)
     # COUNT-over-LEFT-join as device membership counting (q13): the
     # per-probe counts plane replaces the join expansion entirely. A cheap
     # shape prescreen — non-matching aggregates fall through to the ladder
@@ -289,6 +295,17 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
             inner = getattr(built, "inner", None)
             if inner is not None:
                 inner.persist_key = key
+        if built is not False:
+            # AOT program identity is the STABLE key half (no mtimes):
+            # compiled programs depend on plan structure + shapes only
+            # (literal codes/tables ride as runtime aux), so a rewritten
+            # input file keeps its warm programs; memory-scan id() reuse is
+            # harmless here for the same reason (worst case a false hit
+            # serves the identical program)
+            built.aot_key = stable
+            inner = getattr(built, "inner", None)
+            if inner is not None:
+                inner.aot_key = stable
         with _stage_cache_lock:
             stage = _stage_cache.get(key)
             if stage is None:

@@ -788,3 +788,33 @@ def tenancy_stats(reset: bool = False) -> Dict[str, int]:
         if reset:
             _tenancy.clear()
     return out
+
+
+# accumulated low-latency serving-tier events (ISSUE 8): dispatch-path
+# counts (dispatch_push vs dispatch_poll — the latency harness asserts a
+# warm push-enabled cluster runs with ZERO poll-dispatched tasks),
+# compiled-program cache outcomes (compile_trace = a fresh Python trace +
+# XLA compile happened; compile_hit_memory / compile_hit_disk /
+# compile_prewarmed = the AOT tier served it; aot_load_error = corrupt or
+# version-mismatched artifact fell back, with the reason recorded by the
+# caller's log), push-stream health (push_subscribed counts every
+# successful stream open — re-subscribes included — and push_stream_drop
+# every loss), and streaming-collect progress (stream_partition_early = a result
+# partition fetched before the job completed). Same in-process accumulator
+# pattern as readback/join_paths/recovery/tenancy above.
+_serving_lock = threading.Lock()
+_serving: Dict[str, int] = {}  # event -> count; guarded-by: _serving_lock
+
+
+def record_serving(event: str, n: int = 1) -> None:
+    with _serving_lock:
+        _serving[event] = _serving.get(event, 0) + int(n)
+
+
+def serving_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated serving-tier counters."""
+    with _serving_lock:
+        out = dict(_serving)
+        if reset:
+            _serving.clear()
+    return out

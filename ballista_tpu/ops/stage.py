@@ -17,7 +17,6 @@ group counts pad to power-of-two buckets to bound XLA recompilation.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -486,6 +485,11 @@ class FusedAggregateStage:
         # flags), set by kernels.hash_aggregate for file-backed stages only;
         # keys the persisted layout cache (ops/layout_cache.py)
         self.persist_key: Optional[str] = None
+        # STABLE half of the stage cache key (no mtimes — compiled programs
+        # are data-independent), set by kernels.hash_aggregate for every
+        # dispatched stage; keys the persistent AOT program cache
+        # (ops/aotcache.py). None = the AOT tier stays out of the way.
+        self.aot_key: Optional[str] = None
 
     @staticmethod
     def _partial_schema(agg) -> pa.Schema:
@@ -609,10 +613,14 @@ class FusedAggregateStage:
         return jnp.stack(out)
 
     def _build_step(self):
-        import jax
+        from ballista_tpu.ops import aotcache
 
-        return functools.partial(jax.jit, static_argnums=(0,))(
-            self._unrolled_core()
+        # jit with an AOT disk tier underneath (ops/aotcache.py): a cold
+        # process reloads the exported program instead of retracing. A
+        # stage without an aot_key (built outside the kernel dispatcher)
+        # runs the plain jit path inside the wrapper.
+        return aotcache.wrap_step(
+            self, "unrolled", self._unrolled_core(), static_argnums=(0,)
         )
 
     def _unrolled_core(self):
@@ -691,9 +699,11 @@ class FusedAggregateStage:
         return step
 
     def _build_sorted_step(self):
-        import jax
+        from ballista_tpu.ops import aotcache
 
-        return jax.jit(self._sorted_core(), static_argnums=(0,))
+        return aotcache.wrap_step(
+            self, "sorted", self._sorted_core(), static_argnums=(0,)
+        )
 
     def _sorted_core(self):
         """Unjitted device program for the chunked-segment layout
@@ -1729,12 +1739,16 @@ class FusedAggregateStage:
         )
 
     def _build_topk_step(self, fold: bool):
-        import jax
+        from ballista_tpu.ops import aotcache
 
         if fold:
             # (L1, cols, aux, clen, G, owner): G is the segment count
-            return jax.jit(self._topk_core(True), static_argnums=(0, 4))
-        return jax.jit(self._topk_core(False), static_argnums=(0,))
+            return aotcache.wrap_step(
+                self, "topk_fold", self._topk_core(True), static_argnums=(0, 4)
+            )
+        return aotcache.wrap_step(
+            self, "topk", self._topk_core(False), static_argnums=(0,)
+        )
 
     def _topk_core(self, fold: bool):
         """Device Sort+Limit epilogue composed over the sorted core: lower

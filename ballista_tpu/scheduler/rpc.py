@@ -26,6 +26,15 @@ _MAX_MSG = 256 * 1024 * 1024
 GRPC_MESSAGE_OPTIONS = [
     ("grpc.max_send_message_length", _MAX_MSG),
     ("grpc.max_receive_message_length", _MAX_MSG),
+    # a scheduler restart closes every channel (GOAWAY); grpc's default
+    # ~1s initial TCP reconnect backoff would outlast the app-level retry
+    # budget (ballista.rpc.retries x backoff_ms), so a client whose
+    # reconnect attempt lands in the tiny rebind gap reported "connection
+    # refused" for a full second. Restarts are routine here (ISSUE 6
+    # crash tolerance, rolling deploys): reconnect fast, cap at 1s.
+    ("grpc.initial_reconnect_backoff_ms", 50),
+    ("grpc.min_reconnect_backoff_ms", 50),
+    ("grpc.max_reconnect_backoff_ms", 1000),
 ]
 
 _METHODS = {
@@ -38,6 +47,12 @@ _METHODS = {
         pb.ReportLostPartitionParams,
         pb.ReportLostPartitionResult,
     ),
+}
+
+# server-streaming methods (ISSUE 8): the response type streams. Kept in a
+# separate table because the handler/stub constructors differ.
+_STREAM_METHODS = {
+    "SubscribeWork": (pb.SubscribeWorkParams, pb.TaskDefinition),
 }
 
 
@@ -54,6 +69,22 @@ def add_scheduler_service(server: grpc.Server, servicer) -> None:
 
         handlers[name] = grpc.unary_unary_rpc_method_handler(
             make(method),
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+    for name, (req_cls, resp_cls) in _STREAM_METHODS.items():
+        method = getattr(servicer, name, None)
+        if method is None:
+            continue  # wire compat: pre-ISSUE-8 servicers have no stream
+
+        def make_stream(method):
+            def handle(request, context):
+                return method(request, context)
+
+            return handle
+
+        handlers[name] = grpc.unary_stream_rpc_method_handler(
+            make_stream(method),
             request_deserializer=req_cls.FromString,
             response_serializer=lambda m: m.SerializeToString(),
         )
@@ -106,6 +137,13 @@ class SchedulerGrpcClient:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=resp_cls.FromString,
             )
+        self._stream_stubs = {}
+        for name, (req_cls, resp_cls) in _STREAM_METHODS.items():
+            self._stream_stubs[name] = self.channel.unary_stream(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=resp_cls.FromString,
+            )
 
     def _chaos_key(self, name: str) -> str:
         # per-method call index: a RETRY of a failed call draws a fresh
@@ -153,6 +191,14 @@ class SchedulerGrpcClient:
 
     def poll_work(self, params: pb.PollWorkParams) -> pb.PollWorkResult:
         return self._call("PollWork", params)
+
+    def subscribe_work(self, params: pb.SubscribeWorkParams):
+        """Open the push-dispatch stream (ISSUE 8). Returns the live gRPC
+        call object — an iterator of TaskDefinition that also supports
+        .cancel(). NO retry wrapper here: stream life-cycle (reconnect with
+        backoff, fallback to polling while down) belongs to the subscribe
+        loop in executor/execution_loop.py, which must observe every drop."""
+        return self._stream_stubs["SubscribeWork"](params)
 
     def get_job_status(self, params: pb.GetJobStatusParams) -> pb.GetJobStatusResult:
         return self._call("GetJobStatus", params)

@@ -16,11 +16,12 @@ Mirrors the reference's SchedulerServer (rust/scheduler/src/lib.rs:82-428):
 from __future__ import annotations
 
 import logging
+import queue
 import random
 import string
 import threading
 from concurrent import futures
-from typing import Optional
+from typing import Dict, Optional
 
 import grpc
 
@@ -42,6 +43,35 @@ def _job_id() -> str:
     first = random.choice(string.ascii_lowercase)
     rest = "".join(random.choices(string.ascii_lowercase + string.digits, k=6))
     return first + rest
+
+
+class _PushSubscriber:
+    """One executor's open SubscribeWork stream (ISSUE 8).
+
+    `outstanding` is the scheduler-side credit ledger: plan coordinates of
+    tasks pushed over this stream whose terminal status has not arrived yet
+    — at most `slots` may be outstanding, so a slow executor is never
+    buried under pushed work its semaphore cannot absorb. Entries resolve
+    from the executor's own heartbeat statuses and are re-verified against
+    the KV on every pump (a requeued orphan must free its credit). All
+    fields are touched only under the scheduler's global KV lock (pump,
+    PollWork) except `queue`/`closed`, which are internally thread-safe and
+    shared with the stream generator thread."""
+
+    def __init__(self, executor_id: str, slots: int) -> None:
+        self.executor_id = executor_id
+        self.slots = max(1, slots)
+        self.queue: "queue.Queue[pb.TaskDefinition]" = queue.Queue()
+        self.closed = threading.Event()
+        self.outstanding: set = set()  # (job, stage, part, attempt)
+
+    def close(self) -> None:
+        """Close + UNBLOCK: the None sentinel wakes a stream generator
+        parked in queue.get immediately, so scheduler shutdown/restart
+        never waits out the 0.25s tick (a restarted scheduler must rebind
+        its port before retrying clients exhaust their backoff budget)."""
+        self.closed.set()
+        self.queue.put(None)
 
 
 class SchedulerServer:
@@ -92,6 +122,15 @@ class SchedulerServer:
         self._plan_cache_mu = threading.Lock()
         self._plan_cache: "dict[str, bytes]" = {}  # guarded-by: self._plan_cache_mu
         self._plan_cache_cap = 128
+        # push-based task dispatch (ISSUE 8): executor id -> open stream.
+        # The registry lock only guards the dict itself; subscriber credit
+        # state is touched under the global KV lock (see _PushSubscriber).
+        # Ordering: kv.lock() may be held when _push_mu is taken (pump),
+        # NEVER the reverse.
+        self.push_enabled = self.config.push_dispatch()
+        self._push_mu = threading.Lock()
+        self._subscribers: Dict[str, _PushSubscriber] = {}  # guarded-by: self._push_mu
+        self._push_seq = 0  # scheduler.push chaos rotation; under the kv lock
 
     # -- crash simulation ---------------------------------------------------
     def _refuse_if_crashed(self, context) -> None:
@@ -115,6 +154,8 @@ class SchedulerServer:
             "status #%d", self._accepted_statuses,
         )
         self.crashed = True
+        # a dead process's streams die with it
+        self.close_push_streams()
         if self.on_crash is not None:
             try:
                 self.on_crash()
@@ -358,6 +399,188 @@ class SchedulerServer:
             raise RuntimeError("scheduler crashed during planning")
         batch.commit()
         log.info("job %s planned into %d stages", job_id, len(stages))
+        # the whole point of push dispatch: the job's first tasks leave for
+        # subscribed executors the moment planning commits, not after the
+        # next PollWork round-trip
+        with self.state.kv.lock():
+            self._pump_pushes()
+
+    # -- push dispatch (ISSUE 8) --------------------------------------------
+    def _task_definition(self, status: pb.TaskStatus, plan) -> pb.TaskDefinition:
+        """Serialize one assignment into the wire TaskDefinition — the ONE
+        shape both dispatch paths (PollWork reply, SubscribeWork push) send,
+        so the executor cannot tell them apart."""
+        from ballista_tpu.serde.physical import phys_plan_to_proto
+
+        td = pb.TaskDefinition()
+        td.task_id.CopyFrom(status.partition_id)
+        td.attempt = status.attempt
+        td.plan.CopyFrom(phys_plan_to_proto(plan))
+        for k, v in self.state.get_job_settings(
+            status.partition_id.job_id
+        ).items():
+            td.settings.add(key=k, value=v)
+        return td
+
+    def _close_subscriber(self, sub: _PushSubscriber) -> None:
+        sub.close()
+        with self._push_mu:
+            if self._subscribers.get(sub.executor_id) is sub:
+                del self._subscribers[sub.executor_id]
+
+    def close_push_streams(self) -> None:
+        """Close every subscriber stream NOW (shutdown/restart/crash): the
+        generators return on their sentinel instead of finishing a 0.25s
+        tick, so the gRPC server's stop().wait() drains promptly."""
+        with self._push_mu:
+            subs = list(self._subscribers.values())
+            self._subscribers.clear()
+        for sub in subs:
+            sub.close()
+
+    def _pump_pushes(self) -> int:
+        """Assign + push runnable tasks to every subscribed executor with
+        free credit. Caller MUST hold the global KV lock — assignment, the
+        credit ledger, and the chaos sequence all live under it, exactly
+        like the PollWork dispatch path. Returns the number pushed.
+
+        The `scheduler.push` chaos site tears the DELIVERY, after the
+        Running flip: the assignment stands, the subscriber's stream is
+        killed with the verdict, and recovery is exactly the lost-PollWork-
+        response story — the executor's polls never echo the task, the
+        orphaned-assignment grace reconciliation requeues it, and the
+        executor re-subscribes. Keyed on a generation-rotated per-process
+        sequence (like scheduler.admit) so a restarted scheduler draws
+        fresh verdicts."""
+        from ballista_tpu.ops.runtime import record_recovery, record_serving
+        from ballista_tpu.utils.chaos import ChaosInjected
+
+        if not self.push_enabled or self.crashed:
+            return 0
+        with self._push_mu:
+            subs = list(self._subscribers.values())
+        pushed = 0
+        for sub in subs:
+            pushed += self._pump_one_locked(sub)
+        return pushed
+
+    def _pump_one_locked(self, sub: _PushSubscriber) -> int:
+        """Pump ONE subscriber (caller holds the global KV lock). The
+        per-subscriber stream tick calls this for its own stream only —
+        pumping every subscriber from every tick would be O(N^2) idle KV
+        traffic at 4Hz on the scheduler's one lock."""
+        from ballista_tpu.ops.runtime import record_recovery, record_serving
+        from ballista_tpu.utils.chaos import ChaosInjected
+
+        if not self.push_enabled or self.crashed or sub.closed.is_set():
+            return 0
+        # re-verify outstanding credits against the KV: a task requeued
+        # behind our back (orphan reconciliation, lost-task reset) must
+        # free its credit even though no terminal status ever arrives.
+        # Bounded by `slots` reads, and only when credit is actually held.
+        for key in list(sub.outstanding):
+            cur = self.state.get_task_status(key[0], key[1], key[2])
+            if (
+                cur is None
+                or cur.WhichOneof("status") != "running"
+                or cur.attempt != key[3]
+                or cur.running.executor_id != sub.executor_id
+            ):
+                sub.outstanding.discard(key)
+        pushed = 0
+        while len(sub.outstanding) < sub.slots and not sub.closed.is_set():
+            try:
+                assigned = self.state.assign_next_schedulable_task(
+                    sub.executor_id
+                )
+            except ChaosInjected:
+                # scheduler.admit chaos: nothing was written (the abort
+                # fires before the Running flip); the next pump retries
+                # with a rotated admission key — same recovery story as
+                # the aborted-PollWork form of this site
+                break
+            if assigned is None:
+                break
+            status, plan = assigned
+            pid = status.partition_id
+            self._push_seq += 1
+            if self._chaos is not None and self._chaos.should_inject(
+                "scheduler.push",
+                f"g{self.state.generation}/push{self._push_seq}",
+            ):
+                record_recovery("chaos_injected")
+                record_recovery("chaos_push_torn")
+                log.warning(
+                    "chaos[scheduler.push]: tearing delivery of "
+                    "%s/%s/%s to %s (stream killed)",
+                    pid.job_id, pid.stage_id, pid.partition_id,
+                    sub.executor_id,
+                )
+                self._close_subscriber(sub)
+                break
+            td = self._task_definition(status, plan)
+            sub.outstanding.add(
+                (pid.job_id, pid.stage_id, pid.partition_id, status.attempt)
+            )
+            sub.queue.put(td)
+            record_serving("dispatch_push")
+            pushed += 1
+        return pushed
+
+    def SubscribeWork(self, request: pb.SubscribeWorkParams, context=None):
+        """Server-streaming push dispatch (ISSUE 8): register the executor,
+        then stream TaskDefinitions as the pump assigns them. One stream per
+        executor — a new subscription supersedes (and closes) the old one,
+        so a reconnect after a network blip cannot leave a zombie stream
+        holding credit."""
+        self._refuse_if_crashed(context)
+        if not self.push_enabled:
+            if context is not None:
+                context.abort(
+                    grpc.StatusCode.UNIMPLEMENTED,
+                    "push dispatch disabled on this scheduler",
+                )
+            raise RuntimeError("push dispatch disabled")
+        sub = _PushSubscriber(request.metadata.id, request.slots or 4)
+        with self._push_mu:
+            prior = self._subscribers.get(sub.executor_id)
+            if prior is not None:
+                prior.close()
+            self._subscribers[sub.executor_id] = sub
+        log.info("executor %s subscribed for push dispatch (slots=%d)",
+                 sub.executor_id, sub.slots)
+        with self.state.kv.lock():
+            # register the executor before its first poll so assignment's
+            # liveness/blacklist checks see it, then hand it whatever is
+            # already runnable
+            self.state.save_executor_metadata(request.metadata)
+            self._pump_pushes()
+
+        def stream():
+            try:
+                while not sub.closed.is_set() and not self.crashed:
+                    if context is not None and not context.is_active():
+                        return
+                    try:
+                        td = sub.queue.get(timeout=0.25)
+                        if td is None:  # close() sentinel
+                            return
+                    except queue.Empty:
+                        # periodic self-heal pump — THIS subscriber only:
+                        # requeues with no event hook (restart recovery,
+                        # lease-expiry resets) still dispatch within one
+                        # tick, at O(subscribers) total idle cost
+                        try:
+                            with self.state.kv.lock():
+                                self._pump_one_locked(sub)
+                        except Exception:
+                            pass
+                        continue
+                    yield td
+            finally:
+                self._close_subscriber(sub)
+
+        return stream()
 
     def PollWork(self, request: pb.PollWorkParams, context=None) -> pb.PollWorkResult:
         import time as _time
@@ -405,22 +628,35 @@ class SchedulerServer:
                     "requeued %d orphaned assignment(s) for executor %s",
                     n, request.metadata.id,
                 )
+            # push-credit resolution (ISSUE 8): a terminal status from this
+            # executor frees the pushed-task credit it held
+            with self._push_mu:
+                sub = self._subscribers.get(request.metadata.id)
+            if sub is not None:
+                for ts in request.task_status:
+                    if ts.WhichOneof("status") in (
+                        "completed", "failed", "fetch_failed"
+                    ):
+                        pid = ts.partition_id
+                        sub.outstanding.discard(
+                            (pid.job_id, pid.stage_id, pid.partition_id,
+                             ts.attempt)
+                        )
             result = pb.PollWorkResult()
             if request.can_accept_task:
                 assigned = self.state.assign_next_schedulable_task(request.metadata.id)
                 if assigned is not None:
-                    status, plan = assigned
-                    from ballista_tpu.serde.physical import phys_plan_to_proto
+                    from ballista_tpu.ops.runtime import record_serving
 
-                    result.task.task_id.CopyFrom(status.partition_id)
-                    result.task.attempt = status.attempt
-                    result.task.plan.CopyFrom(phys_plan_to_proto(plan))
-                    for k, v in self.state.get_job_settings(
-                        status.partition_id.job_id
-                    ).items():
-                        result.task.settings.add(key=k, value=v)
+                    status, plan = assigned
+                    result.task.CopyFrom(self._task_definition(status, plan))
+                    record_serving("dispatch_poll")
             for job_id in jobs:
                 self.state.synchronize_job_status(job_id)
+            # accepted statuses may have completed upstream stages (or the
+            # credit resolution above freed slots): dispatch the newly
+            # runnable work NOW instead of waiting for a subscriber tick
+            self._pump_pushes()
             return result
 
     def GetJobStatus(self, request: pb.GetJobStatusParams, context=None) -> pb.GetJobStatusResult:
@@ -434,10 +670,13 @@ class SchedulerServer:
     def ReportLostPartition(
         self, request: pb.ReportLostPartitionParams, context=None
     ) -> pb.ReportLostPartitionResult:
-        """A client's result fetch failed against a COMPLETED job: restart
-        the final-stage tasks that died with the named executor through the
-        lineage/retry machinery (scheduler/state.py::restart_completed_job).
-        Declined (restarted=False) when the job is not completed or nothing
+        """A client's result fetch failed: restart the final-stage tasks
+        that died with the named executor through the lineage/retry
+        machinery (scheduler/state.py::restart_completed_job). Covers both
+        a COMPLETED job (the PR 5/6 buffered-fetch case; the status flips
+        back to running) and a still-RUNNING job whose published
+        partial_location died under a streaming client (ISSUE 8). Declined
+        (restarted=False) when the job is terminal-failed/queued or nothing
         completed on that executor — the client re-raises its fetch error."""
         self._refuse_if_crashed(context)
         with self.state.kv.lock():
@@ -476,6 +715,9 @@ class SchedulerServer:
                     )
                     self.state.save_job_metadata(request.job_id, failed)
                     restarted = False
+            if n:
+                # the requeued final-stage tasks are runnable immediately
+                self._pump_pushes()
         log.warning(
             "ReportLostPartition(job=%s, executor=%s, %s/%s): restarted %d",
             request.job_id, request.executor_id,
@@ -522,18 +764,36 @@ class SchedulerServer:
 
 
 def serve(
-    server_impl: SchedulerServer, bind_host: str = "0.0.0.0", port: int = 50050
+    server_impl: SchedulerServer,
+    bind_host: str = "0.0.0.0",
+    port: int = 50050,
+    max_workers: int = 32,
 ) -> grpc.Server:
     from ballista_tpu.scheduler.rpc import GRPC_MESSAGE_OPTIONS
 
+    # each subscribed executor's SubscribeWork stream pins one worker thread
+    # for its lifetime (ISSUE 8): deployments MUST size max_workers to
+    # executor_count + heartbeat headroom (default fits ~16 push executors;
+    # past that, raise it or disable ballista.executor.push_dispatch), or a
+    # full pool would starve PollWork heartbeats and lapse healthy leases
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=16), options=GRPC_MESSAGE_OPTIONS
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=GRPC_MESSAGE_OPTIONS,
     )
     add_scheduler_service(server, server_impl)
     bound = server.add_insecure_port(f"{bind_host}:{port}")
     if bound == 0:
         raise RuntimeError(f"cannot bind scheduler to {bind_host}:{port}")
     server.start()
+    # SubscribeWork streams (ISSUE 8) hold their worker thread inside the
+    # response generator until cancelled; a process exiting WITHOUT a clean
+    # cluster shutdown would then hang in ThreadPoolExecutor's atexit join
+    # forever. Regular atexit callbacks run BEFORE threading's — stopping
+    # the server here cancels every live stream so the join drains.
+    # Idempotent: a second stop() on an already-stopped server is a no-op.
+    import atexit
+
+    atexit.register(server.stop, None)
     log.info("scheduler listening on %s:%s", bind_host, bound)
     server._ballista_port = bound  # actual port when port=0
     return server

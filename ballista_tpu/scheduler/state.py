@@ -287,6 +287,15 @@ class SchedulerState:
         # not raise inside every assignment scan and wedge all scheduling
         self._tenant_weights = self.config.tenant_weights()
         self._tenant_quota = self.config.tenant_max_inflight()
+        # best-effort live result-cache entry count (ISSUE 8): lets the
+        # under-cap common case of result_cache_put skip the full prefix
+        # scan (a 1024-key range read per job completion, under the global
+        # lock, just to learn nothing needs evicting). Lazily seeded from
+        # one scan; the at-cap eviction path re-derives it from the
+        # authoritative scan, so drift (e.g. a peer scheduler's writes)
+        # self-corrects exactly when it would matter. All mutation happens
+        # under the global KV lock the cache paths already hold.
+        self._rc_count: Optional[int] = None
 
     def _key(self, *parts: str) -> str:
         return "/".join(("/ballista", self.namespace) + parts)
@@ -498,7 +507,9 @@ class SchedulerState:
         `cache.put` chaos site (keyed on the content-derived fingerprint —
         a plan coordinate, never a job id): a torn write is recorded and
         SKIPPED, never retried here — the cache is an accelerator, and the
-        job completion that triggered the put stands either way."""
+        job completion that triggered the put stands either way. The
+        size-bound eviction (ISSUE 8) runs BEFORE the insert, so the cache
+        never exceeds max_entries even transiently."""
         from ballista_tpu.utils.chaos import ChaosInjected
 
         entry = pb.ResultCacheEntry(
@@ -509,6 +520,7 @@ class SchedulerState:
         try:
             if self._chaos is not None:
                 self._chaos.maybe_fail("cache.put", f"fp:{fingerprint[:16]}")
+            self._result_cache_evict_for(fingerprint)
             self.kv.put(
                 self._key("resultcache", fingerprint),
                 entry.SerializeToString(),
@@ -521,6 +533,65 @@ class SchedulerState:
             return False
         _record_tenancy("cache_put")
         return True
+
+    def _result_cache_delete(self, fingerprint: str) -> None:
+        """Delete one entry, keeping the best-effort count in step."""
+        self.kv.delete(self._key("resultcache", fingerprint))
+        if self._rc_count is not None:
+            self._rc_count = max(0, self._rc_count - 1)
+
+    def _result_cache_evict_for(self, incoming_fp: str) -> int:
+        """Make room for one incoming entry under the
+        ballista.cache.results.max_entries bound: evict least-recently-HIT
+        entries (never-hit entries rank by created_at) until the insert
+        fits. The recency lives in the KV value (ResultCacheEntry.last_hit,
+        refreshed on every lookup hit), so eviction order survives a
+        scheduler restart. 0 = unbounded. Returns the eviction count.
+
+        The full prefix scan runs only when the maintained count says the
+        cap is actually reached; under-cap puts pay at most one extra
+        kv.get (is this an overwrite?)."""
+        cap = self.config.result_cache_max_entries()
+        if cap <= 0:
+            return 0
+        incoming_key = self._key("resultcache", incoming_fp)
+        if self._rc_count is None:
+            self._rc_count = len(
+                self.kv.get_prefix(self._key("resultcache") + "/")
+            )
+        overwrite = self.kv.get(incoming_key) is not None
+        if not overwrite and self._rc_count < cap:
+            self._rc_count += 1  # the caller's put inserts a fresh key
+            return 0
+        if overwrite and self._rc_count <= cap:
+            return 0  # in-place refresh; no new slot consumed
+        live = []
+        for k, v in self.kv.get_prefix(self._key("resultcache") + "/"):
+            if k == incoming_key:
+                continue  # overwrite in place; no eviction needed for it
+            e = pb.ResultCacheEntry()
+            try:
+                e.ParseFromString(v)
+            except Exception:
+                self.kv.delete(k)  # unreadable entry: reclaim the slot
+                continue
+            live.append((e.last_hit or e.created_at, k))
+        evicted = 0
+        if len(live) >= cap:
+            live.sort()
+            for _recency, k in live[: len(live) - cap + 1]:
+                self.kv.delete(k)
+                evicted += 1
+                _record_tenancy("cache_evicted")
+        # authoritative re-derivation: surviving others + the incoming entry
+        self._rc_count = (len(live) - evicted) + 1
+        if evicted:
+            log.info("result cache evicted %d entries (cap %d)", evicted, cap)
+        return evicted
+
+    def _result_cache_expired(self, entry: pb.ResultCacheEntry) -> bool:
+        ttl = self.config.result_cache_ttl_s()
+        return ttl > 0 and time.time() - entry.created_at > ttl
 
     def result_cache_lookup(self, fingerprint: str):
         """CompletedJob (cached=True) for a live entry, else None.
@@ -537,9 +608,18 @@ class SchedulerState:
             return None
         entry = pb.ResultCacheEntry()
         entry.ParseFromString(v)
+        if self._result_cache_expired(entry):
+            # TTL bound (ISSUE 8): age is measured from creation, not last
+            # hit — a hot entry over stale-but-mtime-identical data still
+            # re-executes once per TTL window
+            self._result_cache_delete(fingerprint)
+            _record_tenancy("cache_expired")
+            log.info("result-cache entry %s... expired (ttl %.0fs)",
+                     fingerprint[:16], self.config.result_cache_ttl_s())
+            return None
         for eid in {pl.executor_meta.id for pl in entry.partition_location}:
             if self.get_executor_metadata(eid) is None:
-                self.kv.delete(key)
+                self._result_cache_delete(fingerprint)
                 _record_tenancy("cache_invalidated")
                 log.info(
                     "result-cache entry %s... invalidated (executor %s gone)",
@@ -549,11 +629,15 @@ class SchedulerState:
         completed = pb.CompletedJob(cached=True)
         for pl in entry.partition_location:
             completed.partition_location.add().CopyFrom(pl)
+        # refresh LRU recency IN the KV value so the eviction order is as
+        # durable as the cache itself (scheduler restarts keep it)
+        entry.last_hit = time.time()
+        self.kv.put(key, entry.SerializeToString())
         _record_tenancy("cache_hit")
         return completed
 
     def result_cache_invalidate(self, fingerprint: str) -> None:
-        self.kv.delete(self._key("resultcache", fingerprint))
+        self._result_cache_delete(fingerprint)
         _record_tenancy("cache_invalidated")
 
     # -- stage plans ----------------------------------------------------------
@@ -872,22 +956,28 @@ class SchedulerState:
         return True
 
     def restart_completed_job(self, job_id: str, executor_id: str) -> int:
-        """Restart a COMPLETED job whose result partitions died with their
-        executor before the client fetched them (PR 5 residue): the client
-        reports the lost location (ReportLostPartition) and the final-stage
-        tasks completed on that executor requeue through the normal
-        retry/lineage machinery — upstream outputs lost with the same
-        executor recover via the fetch_failed path when the re-run fetches
-        them. The job status flips back to running so the client's
-        GetJobStatus poll waits for the fresh result locations. Each restart
-        consumes retry budget; exhaustion fails the job (the client gets an
-        error instead of an eternal fetch loop). Returns the number of
-        restarted tasks; 0 declines the report (job not completed, or
-        nothing on that executor — e.g. a concurrent restart already moved
-        the partitions)."""
+        """Restart a job whose result partitions died with their executor
+        before the client fetched them (PR 5 residue): the client reports
+        the lost location (ReportLostPartition) and the final-stage tasks
+        completed on that executor requeue through the normal retry/lineage
+        machinery — upstream outputs lost with the same executor recover
+        via the fetch_failed path when the re-run fetches them. For a
+        COMPLETED job the status flips back to running so the client's
+        GetJobStatus poll waits for the fresh locations; a job still
+        RUNNING (ISSUE 8: a streaming client fetches partial_location
+        entries mid-job, and one died) requeues the named tasks the same
+        way — without it the dead location would be republished on every
+        status fold until the lease machinery caught up, and the streaming
+        client would spin on it. Each restart consumes retry budget;
+        exhaustion fails the job (the client gets an error instead of an
+        eternal fetch loop). Returns the number of restarted tasks; 0
+        declines the report (job terminal-failed/queued, or nothing on
+        that executor — e.g. a concurrent restart already moved the
+        partitions)."""
         js = self.get_job_metadata(job_id)
-        if js is None or js.WhichOneof("status") != "completed":
+        if js is None or js.WhichOneof("status") not in ("completed", "running"):
             return 0
+        was_completed = js.WhichOneof("status") == "completed"
         tasks = self.get_job_tasks(job_id)
         if not tasks:
             return 0
@@ -914,14 +1004,15 @@ class SchedulerState:
                 return restarted
             _record_recovery("result_partition_restarted")
             restarted += 1
-        if restarted:
+        if restarted and was_completed:
             running = pb.JobStatus()
             running.running.SetInParent()
             self.save_job_metadata(job_id, running)
             _record_recovery("completed_job_restarted")
+        if restarted:
             log.warning(
-                "restarting completed job %s: %d result partition(s) lost "
-                "with executor %s", job_id, restarted, executor_id,
+                "restarting job %s: %d result partition(s) lost with "
+                "executor %s", job_id, restarted, executor_id,
             )
         return restarted
 
@@ -1240,6 +1331,27 @@ class SchedulerState:
                 pl.partition_stats.CopyFrom(t.completed.stats)
         else:
             status.running.SetInParent()
+            # per-partition completion notifications (ISSUE 8): publish the
+            # final-stage result partitions completed SO FAR on the running
+            # status, so a streaming client starts fetching before the last
+            # partition lands. Built exactly like the completed list above —
+            # same location shape, same partition order — and re-derived on
+            # every fold, so a requeued partition simply drops out until its
+            # retry completes again.
+            final_stage = max(t.partition_id.stage_id for t in tasks)
+            for t in sorted(tasks, key=lambda t: t.partition_id.partition_id):
+                if (
+                    t.partition_id.stage_id != final_stage
+                    or t.WhichOneof("status") != "completed"
+                ):
+                    continue
+                pl = status.running.partial_location.add()
+                pl.partition_id.CopyFrom(t.partition_id)
+                meta = self.get_executor_metadata(t.completed.executor_id)
+                if meta is not None:
+                    pl.executor_meta.CopyFrom(meta)
+                pl.path = t.completed.path
+                pl.partition_stats.CopyFrom(t.completed.stats)
         self.save_job_metadata(job_id, status)
         if status.WhichOneof("status") == "completed":
             # publish into the plan-fingerprint result cache (ISSUE 7).

@@ -51,6 +51,18 @@ SITES = (
                              # task out BEFORE the Running flip; the executor
                              # retries its poll and the next admission draws
                              # a fresh verdict (rotated sequence key)
+    "scheduler.push",        # push-dispatch delivery (scheduler/server.py
+                             # pump) — the assignment is ALREADY written when
+                             # the delivery is torn, and the subscriber's
+                             # stream is killed with it: exactly a stream
+                             # drop after the Running flip. The executor
+                             # falls back to polling + re-subscribes; the
+                             # undelivered task requeues through the
+                             # orphaned-assignment grace reconciliation.
+    "aot.load",              # AOT program-cache disk load (ops/aotcache.py)
+                             # — a torn load is recorded with a reason and
+                             # falls back to a fresh trace/compile, like a
+                             # corrupted or version-mismatched artifact
 )
 
 _DENOM = float(1 << 64)

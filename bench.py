@@ -594,7 +594,192 @@ def _multitenant_scenario() -> dict | None:
         cluster.shutdown()
 
 
+def _latency_scenario() -> dict | None:
+    """Low-latency serving-tier scenario (ISSUE 8): closed-loop QPS sweep
+    of SF=0.01-0.1 point-lookup/filter queries against ONE standalone
+    cluster with push dispatch, the persistent AOT program cache (prewarm
+    on), and streaming result collect. Reports per-concurrency p50/p95/p99
+    latency, time-to-first-batch, and the serving counters that prove the
+    fast path engaged: push-vs-poll dispatch counts and the compile-hit
+    rate (a warm tier answers with ZERO fresh traces). The result cache is
+    disabled on purpose — this scenario measures the EXECUTION path, not
+    cache short-circuits (the multitenant scenario covers those).
+
+    Knobs: BENCH_LAT_SF (default 0.01), BENCH_LAT_DURATION seconds per
+    concurrency level (default 10; the CI smoke uses 2), BENCH_LAT_CLIENTS
+    (default "1,4"), BENCH_LAT_BACKEND (default tpu — the compile counters
+    only mean something where stage programs compile; runs under
+    JAX_PLATFORMS=cpu too)."""
+    import threading
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import serving_stats
+    from benchmarks.tpch.datagen import generate, is_complete, register_all
+
+    sf = float(os.environ.get("BENCH_LAT_SF", "0.01"))
+    duration = float(os.environ.get("BENCH_LAT_DURATION", "10"))
+    levels = [
+        int(c) for c in os.environ.get("BENCH_LAT_CLIENTS", "1,4").split(",")
+        if c.strip()
+    ]
+    backend = os.environ.get("BENCH_LAT_BACKEND", "tpu")
+    d = REPO / ".bench_cache" / f"tpch_lat{sf}"
+    if not is_complete(str(d)):
+        d.parent.mkdir(exist_ok=True)
+        generate(str(d), sf=sf, parts=2)
+    queries = {
+        "point": (
+            "select count(*) as n, sum(l_extendedprice) as s from lineitem "
+            "where l_orderkey = 1"
+        ),
+        "filter": (
+            "select sum(l_extendedprice) as revenue, count(*) as n "
+            "from lineitem where l_shipdate >= date '1994-01-01' and "
+            "l_shipdate < date '1995-01-01' and l_quantity < 24"
+        ),
+        "group": (
+            "select l_returnflag, count(*) as n from lineitem "
+            "group by l_returnflag order by l_returnflag"
+        ),
+    }
+    cluster = StandaloneCluster(
+        n_executors=2,
+        config=BallistaConfig({
+            "ballista.executor.backend": backend,
+            "ballista.tpu.aot_cache": str(REPO / ".bench_cache" / "aot_lat"),
+            "ballista.tpu.prewarm": "true",
+            "ballista.tpu.layout_cache_dir":
+                str(REPO / ".bench_cache" / "layouts_lat"),
+            "ballista.cache.results": "false",
+        }),
+    )
+    try:
+        def mk_ctx() -> BallistaContext:
+            ctx = BallistaContext(
+                *cluster.scheduler_addr,
+                settings={
+                    "ballista.executor.backend": backend,
+                    "ballista.cache.results": "false",
+                    "ballista.client.stream_results": "true",
+                    # serving-tier plan shape: a 16-way shuffle is pure
+                    # overhead for point queries (16 final-stage tasks per
+                    # query, each with its own dispatch + status + fetch)
+                    "ballista.shuffle.partitions": "2",
+                },
+            )
+            register_all(ctx, str(d))
+            return ctx
+
+        def timed_query(ctx, sql: str) -> tuple[float, float] | None:
+            """(total_s, ttfb_s) for one streamed query; None on no rows."""
+            import pyarrow as pa
+
+            plan = ctx.sql(sql).logical_plan()
+            t0 = time.perf_counter()
+            ttfb = None
+            batches = []
+            for b in ctx.collect_stream(plan, timeout=120):
+                if ttfb is None:
+                    ttfb = time.perf_counter() - t0
+                batches.append(b)
+            total = time.perf_counter() - t0
+            rows = sum(b.num_rows for b in batches)
+            return (total, ttfb if ttfb is not None else total) if rows else None
+
+        warm_ctx = mk_ctx()
+        for sql in queries.values():  # warmup: trace/compile + caches
+            timed_query(warm_ctx, sql)
+        warm_ctx.close()
+        warm = serving_stats(reset=True)  # drain: attribute to timed sweep
+
+        sweep = []
+        qlist = list(queries.values())
+        for clients in levels:
+            lat: list = []
+            ttfbs: list = []
+            errors: list = []
+            lock = threading.Lock()
+
+            def worker(i: int) -> None:
+                try:
+                    ctx = mk_ctx()
+                    n = 0
+                    while time.perf_counter() - t0 < duration:
+                        r = timed_query(ctx, qlist[(i + n) % len(qlist)])
+                        n += 1
+                        if r is None:
+                            errors.append(f"client{i}: empty result")
+                            return
+                        with lock:
+                            lat.append(r[0])
+                            ttfbs.append(r[1])
+                    ctx.close()
+                except Exception as e:
+                    errors.append(f"client{i}: {e}")
+
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(duration + 240)
+            wall = time.perf_counter() - t0
+            if errors or not lat:
+                print(f"[latency] clients={clients}: "
+                      f"{errors or ['no samples']}", file=sys.stderr)
+                return None
+            lat.sort()
+            ttfbs.sort()
+
+            def pct(xs, q):
+                return round(1000 * xs[min(len(xs) - 1, int(len(xs) * q))], 1)
+
+            row = {
+                "clients": clients,
+                "queries": len(lat),
+                "qps": round(len(lat) / wall, 1),
+                "p50_ms": pct(lat, 0.50),
+                "p95_ms": pct(lat, 0.95),
+                "p99_ms": pct(lat, 0.99),
+                "ttfb_p50_ms": pct(ttfbs, 0.50),
+            }
+            print(f"[latency] {row}", file=sys.stderr)
+            sweep.append(row)
+
+        s = serving_stats(reset=True)
+        hits = (s.get("compile_hit_memory", 0) + s.get("compile_hit_disk", 0)
+                + s.get("compile_prewarmed", 0))
+        traces = s.get("compile_trace", 0)
+        result = {
+            "sf": sf,
+            "duration_s": duration,
+            "sweep": sweep,
+            "dispatch_push": s.get("dispatch_push", 0),
+            "dispatch_poll": s.get("dispatch_poll", 0),
+            "compile_trace": traces,
+            "compile_hits": hits,
+            "compile_hit_rate": round(hits / max(1, hits + traces), 3),
+            "stream_partitions_early": s.get("stream_partition_early", 0),
+            "warmup": {k: v for k, v in warm.items() if v},
+        }
+        print(f"[latency] serving counters: {result['dispatch_push']} push / "
+              f"{result['dispatch_poll']} poll dispatches, compile hit rate "
+              f"{result['compile_hit_rate']}", file=sys.stderr)
+        return result
+    finally:
+        cluster.shutdown()
+
+
 def main() -> None:
+    if os.environ.get("BENCH_LATENCY_ONLY"):
+        # serving-tier scenario only: runs without a reachable device
+        print(json.dumps({"latency": _latency_scenario()}))
+        return
     if os.environ.get("BENCH_MULTITENANT_ONLY"):
         # control-plane scenario only: runs without a reachable device
         print(json.dumps({"multitenant": _multitenant_scenario()}))
@@ -668,6 +853,14 @@ def main() -> None:
             mt = None
         if mt is not None:
             result["multitenant"] = mt
+    if time.monotonic() - _T_START <= MAX_SECONDS:
+        try:
+            latency = _latency_scenario()
+        except Exception as e:
+            print(f"[latency] failed: {e}", file=sys.stderr)
+            latency = None
+        if latency is not None:
+            result["latency"] = latency
     try:
         import jax
 

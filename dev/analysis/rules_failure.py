@@ -33,7 +33,7 @@ _DEFAULT_SITES = frozenset(
     {
         "flight.fetch", "rpc.call", "task.execute", "kv.put",
         "executor.death", "scheduler.plan_write", "scheduler.crash",
-        "cache.put", "scheduler.admit",
+        "cache.put", "scheduler.admit", "scheduler.push", "aot.load",
     }
 )
 

@@ -80,3 +80,36 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_multitenant.py \
     "tests/test_fuzz_device.py::test_fuzz_concurrent_submission_cache"
+
+# strict gate on the low-latency serving tier (ISSUE 8): push dispatch
+# (zero poll-dispatched tasks on a healthy stream; drop -> poll fallback ->
+# re-subscribe; stale-attempt rejection), the persistent AOT program cache
+# (roundtrip, corrupted/version-mismatched artifact fallback, prewarm,
+# aot.load chaos), streaming collect bit-equal to buffered incl. lost-
+# partition recovery, seeded scheduler.push chaos bit-identical to
+# fault-free, adaptive idle-poll backoff, and result-cache eviction.
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_latency_tier.py
+
+# latency harness smoke (ISSUE 8): tiny QPS, 2s budget per level — the
+# p50/p99 + time-to-first-batch + dispatch/compile-counter pipeline is
+# exercised end-to-end on CPU images even though the absolute numbers only
+# mean something on chip. The jq-less assertion: the harness must emit a
+# non-null latency record with zero poll dispatches and a warm compile-hit
+# rate of 1.0.
+JAX_PLATFORMS=cpu BENCH_LATENCY_ONLY=1 BENCH_LAT_DURATION=2 \
+    BENCH_LAT_CLIENTS=1 python bench.py > /tmp/_ballista_lat_smoke.json
+python - /tmp/_ballista_lat_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["latency"]
+assert rec is not None, "latency harness returned no record"
+assert rec["sweep"], "empty QPS sweep"
+for row in rec["sweep"]:
+    for f in ("qps", "p50_ms", "p95_ms", "p99_ms", "ttfb_p50_ms"):
+        assert f in row, f"sweep row missing {f}"
+assert rec["dispatch_poll"] == 0, f"poll-dispatched tasks: {rec}"
+assert rec["dispatch_push"] > 0, f"no push dispatches: {rec}"
+assert rec["compile_trace"] == 0, f"warm sweep traced: {rec}"
+assert rec["compile_hit_rate"] == 1.0, rec
+print("latency smoke OK:", rec["sweep"][0])
+PY

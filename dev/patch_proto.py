@@ -207,12 +207,54 @@ def edit_issue7_multitenant(fdp) -> None:
     add_field(msgs["CompletedJob"], "cached", 2, BOOL)
 
 
+def edit_issue8_latency_tier(fdp) -> None:
+    """ISSUE 8: low-latency serving tier.
+
+    Adds (all wire-compatible field/message/method additions):
+    - SubscribeWorkParams message + the server-streaming SubscribeWork RPC:
+      an executor opens the stream once and the scheduler pushes
+      TaskDefinitions the moment assignment picks them, instead of waiting
+      for the executor's next 250ms PollWork. `slots` seeds the scheduler's
+      per-subscriber credit (how many tasks may be in flight unacknowledged)
+    - RunningJob.partial_location: final-stage result partitions completed
+      SO FAR, published while the job still runs — the client's streaming
+      collect starts fetching (and yielding batches) before the last
+      partition lands
+    - ResultCacheEntry.last_hit: LRU recency for the result-cache
+      size/TTL eviction (ISSUE 8 satellite; survives scheduler restarts
+      because it lives in the KV value itself)
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+    DBL = 1  # FieldDescriptorProto.Type
+
+    sw = fdp.message_type.add()
+    sw.name = "SubscribeWorkParams"
+    add_field(sw, "metadata", 1, MSG, type_name=".ballista.ExecutorMetadata")
+    add_field(sw, "slots", 2, U32)
+
+    add_field(
+        msgs["RunningJob"], "partial_location", 1, MSG,
+        label=REP, type_name=".ballista.PartitionLocation",
+    )
+
+    add_field(msgs["ResultCacheEntry"], "last_hit", 4, DBL)
+
+    svc = {s.name: s for s in fdp.service}.get("SchedulerGrpc")
+    if svc is not None:
+        m = svc.method.add()
+        m.name = "SubscribeWork"
+        m.input_type = ".ballista.SubscribeWorkParams"
+        m.output_type = ".ballista.TaskDefinition"
+        m.server_streaming = True
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
     edit_issue5_orphan_reconcile,
     edit_issue6_scheduler_restart,
     edit_issue7_multitenant,
+    edit_issue8_latency_tier,
 ]
 
 

@@ -695,14 +695,27 @@ def test_completed_job_with_lost_result_partitions_restarts(sales_table):
 
 
 def test_restart_completed_job_declines_non_terminal_and_unknown():
-    """ReportLostPartition is a no-op (restarted=False) for running or
-    unknown jobs and for executors that hold no final-stage output — the
-    client re-raises its fetch error instead of looping."""
+    """ReportLostPartition is a no-op (restarted=False) for unknown/failed
+    jobs and for executors that hold no final-stage output — the client
+    re-raises its fetch error instead of looping. A RUNNING job with a
+    completed final-stage task on the named executor DOES restart it
+    (ISSUE 8: streaming clients fetch partial_location entries mid-job;
+    without the requeue the dead location would be republished on every
+    status fold) — and the job status stays running, no flip needed."""
     s = SchedulerState(MemoryBackend(), "t")
     assert s.restart_completed_job("nope", "e1") == 0
+    failed = pb.JobStatus()
+    failed.failed.error = "x"
+    s.save_job_metadata("jf", failed)
+    s.save_task_status(_task("jf", 1, 0, "completed", "e1"))
+    assert s.restart_completed_job("jf", "e1") == 0  # terminal-failed
     _running_job(s, "jr")
     s.save_task_status(_task("jr", 1, 0, "completed", "e1"))
-    assert s.restart_completed_job("jr", "e1") == 0  # running, not completed
+    assert s.restart_completed_job("jr", "e9") == 0  # e9 holds nothing
+    assert s.restart_completed_job("jr", "e1") == 1  # running: requeued
+    assert s.get_job_metadata("jr").WhichOneof("status") == "running"
+    t = s.get_task_status("jr", 1, 0)
+    assert t.WhichOneof("status") is None and t.attempt == 1
     done = pb.JobStatus()
     done.completed.SetInParent()
     s.save_job_metadata("jc", done)

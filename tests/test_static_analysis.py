@@ -164,6 +164,25 @@ def test_failure_rule_tenancy_site_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_push_site_fixture_pair():
+    """ISSUE 8 satellite: the new scheduler.push / aot.load sites are
+    registered — an unregistered push-stream site and a computed AOT-load
+    site name in latency-tier code fail lint; the registered-literal shapes
+    are clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_push_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "scheduler.stream" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_push_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_failure_rule_sites_track_chaos_registry():
     """The rule reads SITES from ballista_tpu/utils/chaos.py, so the two
     can't drift silently."""
