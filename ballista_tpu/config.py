@@ -151,6 +151,19 @@ BALLISTA_TPU_PREWARM = "ballista.tpu.prewarm"
 # of waiting for the whole job — time-to-first-batch drops to the first
 # partition's latency. Results are bit-identical to the buffered path.
 BALLISTA_STREAM_RESULTS = "ballista.client.stream_results"
+# -- adaptive execution (ISSUE 10, ops/costmodel.py) ------------------------
+# measured cost model behind device-vs-host routing: tier selection past
+# the static ladder, partial offload (split a batch at the tier boundary
+# instead of declining it wholesale), the general skew handler, and
+# build-side switching on observed cardinality misestimates. OFF restores
+# the pure static decline ladder exactly; routing never changes results —
+# bit-identity to the host oracle is the invariant either way.
+BALLISTA_TPU_COST_MODEL = "ballista.tpu.cost_model"
+# persisted per-shape-bucket cost store beside the layout cache, keyed like
+# the AOT cache on op/stage identity + shape bucket + backend fingerprint.
+# "" keeps the store in-memory only (observations still steer routing
+# within the process, nothing survives it).
+BALLISTA_TPU_COST_MODEL_DIR = "ballista.tpu.cost_model_dir"
 # -- deterministic fault injection (utils/chaos.py) -------------------------
 # rate > 0 arms the registered injection sites; each (site, key) pair draws
 # a DETERMINISTIC verdict from sha256(seed, site, key), so a chaos run is
@@ -212,6 +225,11 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_AOT_CACHE_DIR: ".ballista_cache/aot",
     BALLISTA_TPU_PREWARM: "false",
     BALLISTA_STREAM_RESULTS: "false",
+    # default ON with the static ladder as cold-start prior + safety cap: a
+    # cold (or absent, or corrupt) store reproduces pre-adaptive routing
+    BALLISTA_TPU_COST_MODEL: "true",
+    # cwd-relative beside the layout/AOT caches (same rationale)
+    BALLISTA_TPU_COST_MODEL_DIR: ".ballista_cache/costmodel",
     BALLISTA_RPC_RETRIES: "3",
     BALLISTA_RPC_BACKOFF_MS: "50",
     BALLISTA_CHAOS_SEED: "0",
@@ -387,6 +405,18 @@ class BallistaConfig(Mapping[str, str]):
     def stream_results(self) -> bool:
         """Client-side streaming result fetch (ISSUE 8)."""
         return self._settings[BALLISTA_STREAM_RESULTS].lower() in ("1", "true", "yes")
+
+    def tpu_cost_model(self) -> bool:
+        """Adaptive execution (ISSUE 10): measured-cost routing on top of
+        the static decline ladder. False = pure static ladder."""
+        return self._settings[BALLISTA_TPU_COST_MODEL].lower() in ("1", "true", "yes")
+
+    def tpu_cost_model_dir(self) -> str:
+        """Expanded cost-store directory; "" = in-memory only."""
+        import os
+
+        d = self._settings[BALLISTA_TPU_COST_MODEL_DIR].strip()
+        return os.path.expanduser(d) if d else ""
 
     def rpc_retries(self) -> int:
         """Transient-RPC retry attempts beyond the first call."""

@@ -1,0 +1,400 @@
+"""Measured cost model for adaptive execution (ISSUE 10).
+
+Device-vs-host routing used to be a cascade of static admission checks
+tuned blind (JOIN_MULTIPLICITY_TIERS, gather caps, the decline ladder in
+ops/kernels.py). The bench already records the signals needed to do better
+— per-config readback/ingest/join-path counters — and this module closes
+the loop: observed costs feed back into routing decisions.
+
+The store is a per-shape-bucket cost ledger persisted beside the layout
+cache (ballista.tpu.cost_model_dir, default .ballista_cache/costmodel):
+
+  entry key = op | engine | power-of-two units bucket
+  entry     = {s: total seconds, units: total work units, n: observations}
+
+ops in use: "join.gather" (units = padded gather elements), "join.host"
+(units = build+probe rows), "h2d" / "readback" (units = bytes),
+"compile|<step>" and "stage.run|<stage id>" (units = 1; stage id is the
+sha1 of the AOT stable stage key, so the store is keyed like the AOT cache
+on stable stage identity). Entries carry the jax/jaxlib/backend
+fingerprint of the writer (ops/aotcache.py::fingerprint): a store written
+by a different stack is ignored wholesale — costs measured on another
+backend must never steer this one.
+
+Prediction is rate-based: predict(op, engine, units) returns
+units * (total_s / total_units), preferring the exact units bucket when it
+has enough observations and falling back to the op-global rate. Updates
+apply exponential forgetting (history halves once an entry saturates) so
+the rate tracks the current machine, and a gross mispredict REPLACES the
+bucket's history with the observed cost (`retier`) — the
+mispredict-driven re-tiering that pulls an over-eager extended admission
+back to the static ladder.
+
+Decision discipline (bit-identity is the invariant): the cost model only
+changes WHERE a partition runs, never what it returns, and the static
+ladder remains both the cold-start prior and the hard safety cap — a cold
+or corrupt store reproduces the pre-ISSUE-10 routing exactly.
+
+Persistence is best-effort like the layout cache: atomic tmp+rename
+writes, last-writer-wins per key across processes, corrupt or
+fingerprint-mismatched files start an empty store (recorded via the
+routing accumulator, never raised).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, Optional, Tuple
+
+# bump to orphan every persisted entry (they are re-measured, not migrated)
+_FORMAT = 1
+_STORE_BASENAME = "costs.json"
+
+# minimum observations before a rate is trusted for prediction
+MIN_OBSERVATIONS = 4
+# entry saturation: past this, history halves before each update so the
+# rate follows the current machine instead of the all-time mean
+_FORGET_AT = 32
+# flush throttle: observe() persists at most this often (atexit + explicit
+# flush() cover the tail)
+_FLUSH_INTERVAL_S = 5.0
+# observed/predicted ratio beyond which a decision counts as a mispredict
+MISPREDICT_FACTOR = 3.0
+
+_lock = threading.Lock()
+_dir: str = ""  # "" = in-memory only; guarded-by: _lock
+# deliberately lock-free: a single bool written by configure()/reset() and
+# read on hot paths (readback, h2d) — CPython bool loads are atomic and a
+# stale read costs at most one missed/extra observation, never corruption
+_enabled: bool = False
+_loaded: bool = False  # guarded-by: _lock
+_dirty: bool = False  # guarded-by: _lock
+# bumped with every mutation; flush() only clears _dirty when the store it
+# snapshotted is still current, so observations landing during an in-flight
+# flush are never left unpersisted at exit; guarded-by: _lock
+_gen: int = 0
+_last_flush: float = 0.0  # guarded-by: _lock
+# key -> {"s": float, "units": float, "n": int}; guarded-by: _lock
+_store: Dict[str, Dict[str, float]] = {}
+_atexit_registered = False
+
+
+def _record_event(event: str, n: int = 1) -> None:
+    from ballista_tpu.ops.runtime import record_routing_event
+
+    record_routing_event(event, n)
+
+
+def enabled() -> bool:
+    """Cheap hot-path gate (bool read is atomic; staleness is harmless —
+    the worst case is one missed or extra observation around configure)."""
+    return _enabled
+
+
+def configure(config) -> None:
+    """Bind directory + enablement from a config, like the AOT cache. The
+    last configuration wins; a directory change drops the in-memory store
+    (entries lazily reload from the new path)."""
+    global _dir, _enabled, _loaded, _dirty, _gen
+    d = config.tpu_cost_model_dir()
+    en = config.tpu_cost_model()
+    global _atexit_registered
+    global _last_flush
+    with _lock:
+        if d != _dir:
+            _dir = d
+            _store.clear()
+            _gen += 1
+            _loaded = False
+            _dirty = False
+            # start the flush throttle NOW: the first observation on a hot
+            # path (readback, gather) must not pay a synchronous disk
+            # round-trip; atexit + explicit flush() cover the tail
+            _last_flush = time.monotonic()
+        _enabled = en
+        if not _atexit_registered:
+            import atexit
+
+            atexit.register(flush)
+            _atexit_registered = True
+
+
+def reset(clear_dir: bool = False) -> None:
+    """Test hook: drop the in-memory store (and optionally forget the
+    directory) so a fresh process can be simulated."""
+    global _dir, _enabled, _loaded, _dirty, _gen
+    with _lock:
+        _store.clear()
+        _gen += 1
+        _loaded = False
+        _dirty = False
+        if clear_dir:
+            _dir = ""
+            _enabled = False
+
+
+def _fingerprint() -> str:
+    from ballista_tpu.ops import aotcache
+
+    return f"cm{_FORMAT}|{aotcache.fingerprint()}"
+
+
+def _bucket(units: float) -> int:
+    """Power-of-two units bucket (recompilation-control analog: a bounded
+    set of entries per op instead of one per distinct shape)."""
+    b = 1
+    u = max(1, int(units))
+    while b < u:
+        b <<= 1
+    return b
+
+
+def _key(op: str, engine: str, bucket: int) -> str:
+    return f"{op}|{engine}|b{bucket}"
+
+
+# holds-lock: _lock
+def _load_locked() -> None:
+    """Lazy-load the persisted store. Corruption or a fingerprint mismatch
+    starts empty with the reason recorded — a bad store must reproduce
+    cold-start routing, never crash or steer."""
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    if not _dir:
+        return
+    path = os.path.join(_dir, _STORE_BASENAME)
+    try:
+        with open(path) as f:
+            blob = json.load(f)
+        if blob.get("format") != _FORMAT or blob.get("fingerprint") != _fingerprint():
+            _record_event("cost_store_fingerprint_mismatch")
+            return
+        for k, e in blob.get("entries", {}).items():
+            s, units, n = float(e["s"]), float(e["units"]), int(e["n"])
+            if s < 0 or units <= 0 or n <= 0:
+                raise ValueError(f"bad entry {k}")
+            _store[k] = {"s": s, "units": units, "n": n}
+    except FileNotFoundError:
+        return
+    except Exception:
+        _store.clear()
+        _record_event("cost_store_corrupt")
+        return
+
+
+def flush() -> None:
+    """Best-effort atomic persist (tmp+rename). Merge policy is
+    last-writer-wins per key: another process's entries for keys we never
+    touched survive; shared keys take our value. Never raises."""
+    global _dirty, _last_flush
+    with _lock:
+        if not _dir or not _dirty:
+            return
+        entries = {k: dict(v) for k, v in _store.items()}
+        base = _dir
+        gen = _gen
+    try:
+        os.makedirs(base, exist_ok=True)
+        path = os.path.join(base, _STORE_BASENAME)
+        merged = dict(entries)
+        try:
+            with open(path) as f:
+                blob = json.load(f)
+            if (
+                blob.get("format") == _FORMAT
+                and blob.get("fingerprint") == _fingerprint()
+            ):
+                for k, e in blob.get("entries", {}).items():
+                    merged.setdefault(k, e)
+        except Exception:
+            pass
+        fd, tmp = tempfile.mkstemp(dir=base, prefix=".wip-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(
+                    {
+                        "format": _FORMAT,
+                        "fingerprint": _fingerprint(),
+                        "entries": merged,
+                    },
+                    f,
+                )
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        with _lock:
+            if _gen == gen:
+                _dirty = False
+            _last_flush = time.monotonic()
+    except Exception:
+        # still advance the throttle clock: an unwritable dir must not make
+        # every subsequent observe() on a hot path re-attempt a full flush
+        with _lock:
+            _last_flush = time.monotonic()
+        return
+
+
+def observe(op: str, units: float, seconds: float, engine: str = "device") -> None:
+    """Record one measured cost. No-op while the model is disabled, so hot
+    paths (readback, h2d) can call unconditionally."""
+    if not _enabled or seconds < 0 or units <= 0:
+        return
+    global _dirty, _last_flush, _gen
+    k = _key(op, engine, _bucket(units))
+    with _lock:
+        _load_locked()
+        e = _store.get(k)
+        if e is None:
+            _store[k] = {"s": float(seconds), "units": float(units), "n": 1}
+        else:
+            if e["n"] >= _FORGET_AT:
+                e["s"] *= 0.5
+                e["units"] *= 0.5
+                e["n"] = e["n"] // 2
+            e["s"] += float(seconds)
+            e["units"] += float(units)
+            e["n"] += 1
+        _dirty = True
+        _gen += 1
+        due = _dir and time.monotonic() - _last_flush > _FLUSH_INTERVAL_S
+        if due:
+            # claim the flush slot under the lock so a burst of observes
+            # spawns ONE writer, then persist off the hot path — a device
+            # readback must never wait on a disk round-trip
+            _last_flush = time.monotonic()
+    if due:
+        threading.Thread(
+            target=flush, daemon=True, name="costmodel-flush"
+        ).start()
+
+
+def seed(op: str, units: float, seconds: float, engine: str = "device",
+         n: int = MIN_OBSERVATIONS) -> None:
+    """Directly install a warm entry (tests + the fuzz slice's adversarial
+    entries). Replaces any history for the bucket."""
+    global _dirty, _gen
+    with _lock:
+        _load_locked()
+        _store[_key(op, engine, _bucket(units))] = {
+            "s": float(seconds), "units": float(units), "n": int(n),
+        }
+        _dirty = True
+        _gen += 1
+
+
+def retier(op: str, units: float, seconds: float, engine: str = "device") -> None:
+    """Mispredict-driven re-tiering: REPLACE the bucket's history with the
+    observed cost, so the very next prediction reflects reality instead of
+    averaging the surprise away."""
+    if not _enabled:
+        return
+    global _dirty, _gen
+    with _lock:
+        _load_locked()
+        _store[_key(op, engine, _bucket(units))] = {
+            "s": float(seconds), "units": float(units), "n": MIN_OBSERVATIONS,
+        }
+        _dirty = True
+        _gen += 1
+    _record_event("retier")
+
+
+def gross_mispredict(predicted: float, observed: float) -> bool:
+    """True when observed deviates from predicted by MISPREDICT_FACTOR in
+    EITHER direction — the one accounting definition shared by the routing
+    mispredict counter and the re-tiering below."""
+    return (
+        observed > MISPREDICT_FACTOR * predicted
+        or observed * MISPREDICT_FACTOR < predicted
+    )
+
+
+def check_mispredict(op: str, units: float, predicted: Optional[float],
+                     observed: float, engine: str = "device") -> bool:
+    """Canonical post-decision check: a gross mispredict (either way)
+    re-tiers the bucket so the next prediction reflects reality. Returns
+    whether it fired. Every consumer that predicted a cost runs this —
+    one implementation, so no site can drift to a one-sided check."""
+    if predicted is None or not gross_mispredict(predicted, observed):
+        return False
+    retier(op, units, observed, engine=engine)
+    return True
+
+
+@contextmanager
+def timed(op: str, units: float = 1.0, engine: str = "device",
+          routing_op: Optional[str] = None,
+          predictive: bool = True) -> Iterator[None]:
+    """Time the body as one measured decision — the single implementation
+    of the predict/observe/record-routing/re-tier accounting contract, so
+    no call site can drift to a partial or one-sided variant. A body
+    exception skips the accounting entirely (a failed attempt is not an
+    observation of the op's cost). `routing_op` additionally records the
+    decision in the routing accumulator under `engine`; predictive=False
+    degrades to a plain timed observation (the host-side alternative-cost
+    probes, which must not re-tier)."""
+    predicted = predict(op, units, engine=engine) if predictive else None
+    t0 = time.perf_counter()
+    yield
+    dt = time.perf_counter() - t0
+    observe(op, units, dt, engine=engine)
+    if routing_op is not None:
+        from ballista_tpu.ops.runtime import record_routing
+
+        record_routing(engine, routing_op, predicted, dt)
+    if predictive:
+        check_mispredict(op, units, predicted, dt, engine=engine)
+
+
+def rate(op: str, engine: str = "device") -> Optional[Tuple[float, int]]:
+    """Op-global (seconds per unit, observation count) across buckets, or
+    None when nothing was observed."""
+    prefix = f"{op}|{engine}|b"
+    with _lock:
+        _load_locked()
+        s = units = 0.0
+        n = 0
+        for k, e in _store.items():
+            if k.startswith(prefix):
+                s += e["s"]
+                units += e["units"]
+                n += int(e["n"])
+    if n == 0 or units <= 0:
+        return None
+    return s / units, n
+
+
+def predict(op: str, units: float, engine: str = "device") -> Optional[float]:
+    """Predicted seconds for `units` of `op` on `engine`: the exact units
+    bucket when it has MIN_OBSERVATIONS, else the op-global rate, else None
+    (cold — callers fall back to the static prior)."""
+    if not _enabled:
+        return None
+    k = _key(op, engine, _bucket(units))
+    with _lock:
+        _load_locked()
+        e = _store.get(k)
+        if e is not None and e["n"] >= MIN_OBSERVATIONS and e["units"] > 0:
+            return units * e["s"] / e["units"]
+    r = rate(op, engine)
+    if r is None or r[1] < MIN_OBSERVATIONS:
+        return None
+    return units * r[0]
+
+
+def snapshot() -> Dict[str, Dict[str, float]]:
+    """Copy of the in-memory store (tests/diagnostics)."""
+    with _lock:
+        _load_locked()
+        return {k: dict(v) for k, v in _store.items()}

@@ -144,10 +144,12 @@ class FactAggregateStage:
             return FactAggregateStage(agg)
         except UnsupportedOnDevice as e:
             from ballista_tpu.ops.kernels import step_aside
+            from ballista_tpu.ops.runtime import record_routing_event
 
             # not the end of the ladder: hash_aggregate tries the mapped
             # rewrite next (the query may still run fully on device), but
             # the reason why factagg stepped aside must stay observable
+            record_routing_event("factagg.step_aside")
             return step_aside(f"factagg admission: {e}")
 
     def __init__(self, agg) -> None:
@@ -621,7 +623,12 @@ class FactAggregateStage:
                 )
             return jnp.stack(outs, axis=1)  # [R_packed, GA_pad]
 
-        return jax.jit(step_sec, static_argnums=(0,))
+        # AOT disk tier (ISSUE 10 satellite, PR 8 residue): factagg steps
+        # reload as compile_hit_disk in a cold process instead of retracing
+        from ballista_tpu.ops import aotcache
+
+        return aotcache.wrap_step(self, "factagg_sec", step_sec,
+                                  static_argnums=(0,))
 
     def _run_secondary(self, ent: dict, ctx) -> pa.Table:
         import jax.numpy as jnp
@@ -773,13 +780,19 @@ class FactAggregateStage:
                     ]
                 )
 
-            return jax.jit(step_topk, static_argnums=(0,))
+            from ballista_tpu.ops import aotcache
+
+            return aotcache.wrap_step(self, "factagg_topk", step_topk,
+                                      static_argnums=(0,))
 
         def step_select(L1, cols, aux, clen, positions):
             stacked = core(L1, cols, aux, clen)
             return jnp.take(stacked, positions, axis=1)
 
-        return jax.jit(step_select, static_argnums=(0,))
+        from ballista_tpu.ops import aotcache
+
+        return aotcache.wrap_step(self, "factagg_select", step_select,
+                                  static_argnums=(0,))
 
     # ------------------------------------------------------------------
     def _dim_side(self, ctx) -> dict:

@@ -9,8 +9,31 @@ longer decline: run-lengths exclusive-scan into per-probe output offsets on
 the host flatten, and matches materialize through a bounded-width gather
 whose static width is the smallest admission tier
 (ops/kernels.py::JOIN_MULTIPLICITY_TIERS) covering the observed maximum
-multiplicity, keeping every program shape static. Shapes past the top tier
-(or past the gather element cap) step aside to the host sort-merge join
+multiplicity, keeping every program shape static.
+
+Adaptive execution (ISSUE 10) replaces the wholesale decline past the
+static ladder with three measured-cost escapes, every one bit-identical to
+the host oracle:
+
+- **extended tiers** — with a warm cost store whose evidence says the
+  device gather beats the host join (kernels.join_extended_tier), widths
+  512/1024 admit under hard caps; a gross mispredict re-tiers the store so
+  the next decision falls back.
+- **partial offload** — a batch past a tier boundary SPLITS at the
+  boundary: probes whose run-length fits the boundary tier gather on
+  device, the few dominant (skewed) keys past it join on the host oracle,
+  and the two selections merge probe-major — bit-identical to the
+  wholesale host join by construction, asserted against the oracle's own
+  run-lengths before merging.
+- **cold paths unchanged** — no config / cost model off / no structural
+  skew reproduces the pre-adaptive step-aside exactly.
+
+Both compiled programs (the runs kernel and each gather width) ride the
+persistent AOT disk tier (ops/aotcache.py) under a stable plan-independent
+key, so a cold process reloads them as compile_hit_disk instead of fresh
+traces (ISSUE 10 satellite; PR 8 residue).
+
+Shapes past every escape step aside to the host sort-merge join
 (physical/joinutil.py) with a recorded reason; both paths share the same
 key normalization and emit matches in the same order — probe-major, build
 rows in stable sorted order within a probe key — so device results are
@@ -18,12 +41,15 @@ bit-identical to the host oracle, multiplicity and order included.
 
 Every decline flows through the canonical kernels helpers AND
 runtime.record_join_path, so bench.py's per-config join-path counters
-(device / step_aside / host_fallback, with reasons) stay truthful.
+(device / split / step_aside / host_fallback, with reasons) stay truthful;
+every engine choice additionally lands in the routing accumulator
+(runtime.record_routing) with its predicted-vs-observed cost.
 """
 
 from __future__ import annotations
 
 import functools
+import time
 from typing import Optional, Tuple
 
 import numpy as np
@@ -34,9 +60,33 @@ from ballista_tpu.ops.runtime import (
     pad_to,
     readback,
     record_join_path,
+    record_routing,
+    record_routing_event,
+    routing_probe,
 )
 
 _PAD_CODE = np.int32(2**31 - 1)  # sorts last, never matches a valid probe
+
+# partial offload engages only for the skew shape it is built for: at most
+# this many DISTINCT keys past the tier boundary go to the host remainder
+# (a broadly-duplicated build is not a split candidate — host-wholesale or
+# an evidence-backed extended tier handles it)
+_SPLIT_MAX_HOT_KEYS = 16
+# planned-build-side row excess past which the observed cardinalities are
+# treated as a plan-time misestimate and the build side switches
+_BUILD_SWAP_RATIO = 4
+
+
+class _JoinProgramOwner:
+    """AOT-cache identity for the device-join programs. They are pure
+    shape functions — no plan structure, no literals — so one stable key
+    serves every join and a cold executor reloads them from disk
+    (compile_hit_disk) instead of retracing."""
+
+    aot_key = "ops.join"
+
+
+_AOT_OWNER = _JoinProgramOwner()
 
 
 def match_runs(sorted_codes, probe_codes):
@@ -69,29 +119,32 @@ def gather_matches(values, starts, counts, width: int):
 
 @functools.lru_cache(maxsize=None)
 def _runs_kernel():
-    import jax
-    import jax.numpy as jnp
+    from ballista_tpu.ops import aotcache
 
-    @jax.jit
     def runs(build_codes, probe_codes):
+        import jax.numpy as jnp
+
         # stable: equal build keys keep original row order, matching the
         # host oracle's kind="stable" argsort (bit-equal output order)
         order = jnp.argsort(build_codes, stable=True)
         starts, counts = match_runs(build_codes[order], probe_codes)
         return order, starts, counts
 
-    return runs
+    return aotcache.wrap_step(_AOT_OWNER, "join_runs", runs, static_argnums=())
 
 
 @functools.lru_cache(maxsize=None)
 def _gather_kernel(width: int):
-    import jax
+    from ballista_tpu.ops import aotcache
 
-    @jax.jit
     def gather(order, starts, counts):
         return gather_matches(order, starts, counts, width)
 
-    return gather
+    # width is baked into the closure, not an argument: the program name
+    # carries it so each width keys its own AOT artifact
+    return aotcache.wrap_step(
+        _AOT_OWNER, f"join_gather_w{width}", gather, static_argnums=()
+    )
 
 
 def _decline(kind: str, reason: str) -> None:
@@ -103,6 +156,7 @@ def _decline(kind: str, reason: str) -> None:
     from ballista_tpu.ops.kernels import host_fallback
 
     record_join_path(kind, reason)
+    record_routing("host", "join")
     return host_fallback(reason)
 
 
@@ -131,8 +185,162 @@ def _counts_plane(build_codes: np.ndarray, probe_codes: np.ndarray):
     return order, starts, counts, counts_h, np_
 
 
+def _run_gather(order, starts, counts, tier: int, np_: int) -> Tuple[np.ndarray, float]:
+    """Execute the bounded-width gather at `tier` and feed the cost store:
+    (matched-plane [np_, tier], observed seconds)."""
+    from ballista_tpu.ops import costmodel
+
+    t0 = time.perf_counter()
+    mat = readback(_gather_kernel(tier)(order, starts, counts), rows=np_)[:np_]
+    dt = time.perf_counter() - t0
+    costmodel.observe("join.gather", int(counts.shape[0]) * tier, dt)
+    return mat, dt
+
+
+def _flatten_matched(mat: np.ndarray, counts_h: np.ndarray, np_: int):
+    """Host flatten of the gathered match plane: probe-major (build, probe)
+    selections — the run-length exclusive scan is implicit in the
+    row-major compaction (probe-major, slot order within each probe)."""
+    tier = mat.shape[1]
+    keep = np.arange(tier, dtype=np.int32)[None, :] < counts_h[:, None]
+    build_idx = mat[keep].astype(np.int64)
+    probe_idx = np.repeat(np.arange(np_, dtype=np.int64), counts_h)
+    return build_idx, probe_idx
+
+
+def _within_runs(counts: np.ndarray) -> np.ndarray:
+    """[0..c) position index for each run of a counts vector, flattened."""
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    cum = np.cumsum(counts, dtype=np.int64)
+    return np.arange(total, dtype=np.int64) - np.repeat(cum - counts, counts)
+
+
+def _split_offload(
+    order, starts, counts, counts_h, np_,
+    build_codes: np.ndarray, probe_codes: np.ndarray,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Partial offload (ISSUE 10): split the batch at the tier boundary
+    instead of declining it wholesale. Probes whose run-length fits the
+    largest cap-admissible tier gather on device; the dominant keys past it
+    (at most _SPLIT_MAX_HOT_KEYS distinct — the skew shape) join on the
+    host oracle; selections merge probe-major. Bit-identity with the
+    wholesale host join holds by construction — both sides emit build rows
+    in stable sorted order within a probe key — and the host remainder's
+    run-lengths are asserted against the device counts plane before the
+    merge. Returns None when the shape is not a split candidate."""
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.ops.kernels import (
+        JOIN_GATHER_CAP,
+        JOIN_MULTIPLICITY_TIERS,
+        join_multiplicity_tier,
+    )
+    from ballista_tpu.physical.joinutil import join_indices
+
+    probe_slots = int(counts.shape[0])
+    boundary = JOIN_MULTIPLICITY_TIERS[0]
+    for t in JOIN_MULTIPLICITY_TIERS:
+        if t == 1 or probe_slots * t <= JOIN_GATHER_CAP:
+            boundary = t
+    hot = counts_h > boundary
+    if not hot.any():
+        return None  # nothing past the boundary: not this escape's shape
+    if len(np.unique(probe_codes[hot])) > _SPLIT_MAX_HOT_KEYS:
+        return None  # broad duplication, not skew — splitting buys nothing
+    cold = ~hot
+    cold_max = int(counts_h[cold].max()) if cold.any() else 0
+    cold_tier, _why = join_multiplicity_tier(cold_max, probe_slots)
+    if cold_tier is None or cold_tier > boundary:
+        return None
+    # input-row units, like every other join.host site (the op-global rate
+    # is shared; match-count units would dilute it and skew the extended-
+    # tier gate's host predictions)
+    host_units = len(build_codes) + int(hot.sum())
+    predicted = None
+    dev_pred = costmodel.predict("join.gather", probe_slots * cold_tier)
+    host_pred = costmodel.predict("join.host", host_units, engine="host")
+    if dev_pred is not None and host_pred is not None:
+        predicted = dev_pred + host_pred
+
+    mat, dt_dev = _run_gather(order, starts, counts, cold_tier, np_)
+    # host remainder: the oracle on the hot probes only
+    hot_sel = np.flatnonzero(hot)
+    t_host = time.perf_counter()
+    bi_hot, pi_hot = join_indices(build_codes, probe_codes[hot_sel], "inner")
+    dt_host = time.perf_counter() - t_host
+    costmodel.observe("join.host", host_units, dt_host, engine="host")
+    # per-op re-tiering on gross mispredicts (either direction): without
+    # it a first-call trace/compile outlier inflates the gather rate for
+    # _FORGET_AT observations and the composite prediction stays wrong
+    costmodel.check_mispredict(
+        "join.gather", probe_slots * cold_tier, dev_pred, dt_dev
+    )
+    costmodel.check_mispredict(
+        "join.host", host_units, host_pred, dt_host, engine="host"
+    )
+    # decision-point oracle assertion: the host remainder's run-lengths
+    # must equal the device counts plane for those probes — a mismatch
+    # means the two engines disagree about the data and the split must not
+    # merge (fall back to the wholesale host join instead)
+    hot_counts = counts_h[hot_sel].astype(np.int64)
+    if len(bi_hot) != int(hot_counts.sum()) or not np.array_equal(
+        np.bincount(pi_hot, minlength=len(hot_sel)), hot_counts
+    ):
+        record_routing_event("split_oracle_mismatch")
+        return None
+
+    offsets = np.concatenate(
+        ([0], np.cumsum(counts_h, dtype=np.int64)[:-1])
+    )
+    total = int(counts_h.sum())
+    build_idx = np.empty(total, dtype=np.int64)
+    cold_sel = np.flatnonzero(cold)
+    cold_counts = counts_h[cold_sel].astype(np.int64)
+    keep_cold = (
+        np.arange(cold_tier, dtype=np.int32)[None, :] < counts_h[:, None]
+    ) & cold[:, None]
+    build_idx[
+        np.repeat(offsets[cold_sel], cold_counts) + _within_runs(cold_counts)
+    ] = mat[keep_cold].astype(np.int64)
+    build_idx[
+        np.repeat(offsets[hot_sel], hot_counts) + _within_runs(hot_counts)
+    ] = bi_hot
+    probe_idx = np.repeat(np.arange(np_, dtype=np.int64), counts_h)
+    record_join_path("split", "partial offload at the tier boundary")
+    # observed = the modeled work (gather + host join); the merge scatter
+    # and oracle assertion are not part of the prediction, so timing them
+    # would bill measurement scope as model error in the mispredict rate
+    record_routing("split", "join", predicted, dt_dev + dt_host)
+    record_routing_event("split")
+    return build_idx, probe_idx, counts_h.astype(np.int64)
+
+
+def _extended_gather(
+    order, starts, counts, counts_h, np_,
+    max_mult: int, host_units: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Evidence-gated gather at an extended tier (past the static ladder).
+    A gross mispredict re-tiers the cost store so the next decision for
+    this shape bucket falls back to the static prior."""
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.ops.kernels import join_extended_tier
+
+    probe_slots = int(counts.shape[0])
+    ext = join_extended_tier(max_mult, probe_slots, host_units)
+    if ext is None:
+        return None
+    tier, dev_pred, _host_pred = ext
+    mat, dt = _run_gather(order, starts, counts, tier, np_)
+    record_routing("device", "join.extended", dev_pred, dt)
+    costmodel.check_mispredict("join.gather", probe_slots * tier, dev_pred, dt)
+    build_idx, probe_idx = _flatten_matched(mat, counts_h, np_)
+    record_join_path("device", "extended tier past the static ladder")
+    return build_idx, probe_idx, counts_h.astype(np.int64)
+
+
 def device_join_indices(
-    build_codes: np.ndarray, probe_codes: np.ndarray
+    build_codes: np.ndarray, probe_codes: np.ndarray, config=None
 ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """M:N inner-join row selections computed on device.
 
@@ -144,7 +352,14 @@ def device_join_indices(
     ``counts == 0``). None when the device path declines (empty side, code
     range too wide for int32, multiplicity past the top admission tier);
     every decline carries a recorded reason.
+
+    With a config whose ``ballista.tpu.cost_model`` is on, shapes the
+    static ladder declines first try the measured-cost escapes (extended
+    tier, partial-offload split — see the module docstring); without one
+    the static ladder is the whole story, so direct callers keep the
+    pre-adaptive contract exactly.
     """
+    from ballista_tpu.ops import costmodel
     from ballista_tpu.ops.kernels import join_multiplicity_tier
 
     plane = _counts_plane(build_codes, probe_codes)
@@ -152,17 +367,35 @@ def device_join_indices(
         return None  # reason recorded by _counts_plane's decline
     order, starts, counts, counts_h, np_ = plane
     max_mult = int(counts_h.max())
-    tier, why = join_multiplicity_tier(max_mult, int(counts.shape[0]))
-    if tier is None:
-        return _decline("step_aside", why)
-    mat = readback(_gather_kernel(tier)(order, starts, counts), rows=np_)[:np_]
-    # host flatten: the run-length exclusive scan is implicit in the
-    # row-major compaction (probe-major, slot order within each probe)
-    keep = np.arange(tier, dtype=np.int32)[None, :] < counts_h[:, None]
-    build_idx = mat[keep].astype(np.int64)
-    probe_idx = np.repeat(np.arange(np_, dtype=np.int64), counts_h)
-    record_join_path("device")
-    return build_idx, probe_idx, counts_h.astype(np.int64)
+    probe_slots = int(counts.shape[0])
+    tier, why = join_multiplicity_tier(max_mult, probe_slots)
+    if tier is not None:
+        predicted = costmodel.predict("join.gather", probe_slots * tier)
+        mat, dt = _run_gather(order, starts, counts, tier, np_)
+        build_idx, probe_idx = _flatten_matched(mat, counts_h, np_)
+        record_join_path("device")
+        record_routing("device", "join", predicted, dt)
+        # gross mispredict either way re-tiers the bucket: a first-call
+        # trace/compile outlier otherwise inflates the rate for _FORGET_AT
+        # observations, steering extended admission and the split decision
+        # off steady-state reality
+        costmodel.check_mispredict(
+            "join.gather", probe_slots * tier, predicted, dt
+        )
+        return build_idx, probe_idx, counts_h.astype(np.int64)
+    if config is not None and config.tpu_cost_model():
+        costmodel.configure(config)
+        host_units = len(build_codes) + len(probe_codes)
+        res = _extended_gather(
+            order, starts, counts, counts_h, np_, max_mult, host_units
+        )
+        if res is None:
+            res = _split_offload(
+                order, starts, counts, counts_h, np_, build_codes, probe_codes
+            )
+        if res is not None:
+            return res
+    return _decline("step_aside", why)
 
 
 def device_membership_counts(
@@ -183,6 +416,7 @@ def device_membership_counts(
         return None  # reason recorded by _counts_plane's decline
     _order, _starts, _counts, counts_h, _np = plane
     record_join_path("device")
+    record_routing("device", "join.counts")
     return counts_h.astype(np.int64)
 
 
@@ -191,17 +425,45 @@ def try_device_inner_join(
     probe: pa.Table,
     build_keys: list,
     probe_keys: list,
+    config=None,
 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """Returns (build_idx, probe_idx) row selections realizing the inner
     join — duplicate build keys expand to their full multiplicity — or None
-    if the device path declines."""
+    if the device path declines.
+
+    Runtime re-planning (ISSUE 10): when the cost model is on and the
+    observed row counts say the planner picked the wrong build side (build
+    more than _BUILD_SWAP_RATIO times the probe), the sides swap — the
+    device sorts the smaller plane — and the canonical probe-major order
+    is restored host-side. Within a probe key every matched build row
+    carries the SAME key code, so the oracle's "stable sorted build order"
+    is simply build-row-ascending; a stable sort of the swapped result by
+    probe row reproduces it exactly, keeping bit-identity."""
     from ballista_tpu.physical.joinutil import combined_key_codes
 
     bcodes, pcodes = combined_key_codes(
         [build.column(k) for k in build_keys],
         [probe.column(k) for k in probe_keys],
     )
-    res = device_join_indices(bcodes, pcodes)
+    if (
+        config is not None
+        and config.tpu_cost_model()
+        and len(bcodes) > _BUILD_SWAP_RATIO * max(1, len(pcodes))
+    ):
+        # probe scope: the swapped shape may decline (its multiplicity
+        # profile differs), in which case the planned-side attempt below
+        # records the real decision — without the probe one join would
+        # count BOTH the probe's host decline and the planned outcome
+        with routing_probe() as rp:
+            swapped = device_join_indices(pcodes, bcodes, config)
+        if swapped is not None:
+            rp.commit()
+            record_routing_event("join_build_swapped")
+            p_rows, b_rows, _counts = swapped
+            perm = np.argsort(p_rows, kind="stable")
+            return b_rows[perm], p_rows[perm]
+        # fall through to the planned sides before giving up on the device
+    res = device_join_indices(bcodes, pcodes, config)
     if res is None:
         return None
     build_idx, probe_idx, _counts = res

@@ -44,11 +44,12 @@ def host_fallback(reason: str) -> None:
     """Canonical Optional-sentinel decline: logs + counts the reason, then
     returns the None the dispatcher maps to the host Arrow path. Use this
     instead of a bare `return None` inside UnsupportedOnDevice handlers so
-    declines stay observable (tracing counter + debug log)."""
-    from ballista_tpu.utils import tracing
+    declines stay observable (tracing counter + debug log). Inside a
+    routing probe the trace buffers with the decision counters, so a
+    speculative attempt that declined leaves no phantom fallback trace."""
+    from ballista_tpu.ops.runtime import record_decline_trace
 
-    tracing.incr("device.host_fallback")
-    logging.getLogger("ballista.tpu").debug("host fallback: %s", reason)
+    record_decline_trace("device.host_fallback", f"host fallback: {reason}")
     return None
 
 
@@ -58,10 +59,9 @@ def step_aside(reason: str) -> None:
     query may still run fully on device. Counted separately from
     host_fallback — conflating them would make the device path look
     disengaged on queries that ran on-chip."""
-    from ballista_tpu.utils import tracing
+    from ballista_tpu.ops.runtime import record_decline_trace
 
-    tracing.incr("device.step_aside")
-    logging.getLogger("ballista.tpu").debug("ladder step-aside: %s", reason)
+    record_decline_trace("device.step_aside", f"ladder step-aside: {reason}")
     return None
 
 
@@ -105,6 +105,47 @@ def join_multiplicity_tier(
         f"build-key multiplicity {max_mult} exceeds top tier "
         f"{JOIN_MULTIPLICITY_TIERS[-1]}"
     )
+
+
+# -- cost-model tier extension (ISSUE 10) ------------------------------------
+# The static ladder above stays the cold-start prior AND the hard safety
+# cap: a shape it declines may still run on device, but ONLY when the
+# measured cost store (ops/costmodel.py) has enough evidence that the
+# device gather beats the host join for that shape — and never past the
+# hard caps below, which bound the worst case a wrong store can cost.
+JOIN_EXTENDED_TIERS = (512, 1024)
+JOIN_GATHER_HARD_CAP = JOIN_GATHER_CAP * 4
+# predicted device cost must beat the host prediction by this margin:
+# close calls stay on the proven static routing
+_EXT_MARGIN = 0.75
+
+
+def join_extended_tier(
+    max_mult: int, probe_slots: int, host_units: int
+) -> Optional[Tuple[int, float, float]]:
+    """Evidence-gated admission past the static ladder: (tier, predicted
+    device seconds, predicted host seconds) when the warm cost store says
+    the bounded-width gather beats the host join by _EXT_MARGIN — None
+    when cold (no evidence = static prior stands), unfavorable, or past
+    the hard cap. The static widths are candidates too: a join declined
+    purely on the ELEMENT cap (max_mult inside the ladder) re-admits at
+    its natural width under the hard cap, not at a 2x-wasteful extended
+    width. `host_units` is the host join's work measure (build + probe
+    rows)."""
+    from ballista_tpu.ops import costmodel
+
+    for tier in JOIN_MULTIPLICITY_TIERS + JOIN_EXTENDED_TIERS:
+        if max_mult <= tier:
+            if probe_slots * tier > JOIN_GATHER_HARD_CAP:
+                return None
+            dev = costmodel.predict("join.gather", probe_slots * tier)
+            host = costmodel.predict("join.host", host_units, engine="host")
+            if dev is None or host is None:
+                return None  # cold store: the static ladder is the prior
+            if dev < _EXT_MARGIN * host:
+                return tier, dev, host
+            return None
+    return None
 
 # executor task threads run concurrently: lookup/evict/insert must be one
 # atomic section or two threads can each build (and pin) the same stage.
@@ -151,10 +192,12 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     _configure_jax_cache()
     # AOT program-cache wiring (ISSUE 8): bind the disk tier's directory +
     # chaos injector from this dispatch's config so the stage steps built
-    # below resolve through it
-    from ballista_tpu.ops import aotcache
+    # below resolve through it. The cost model (ISSUE 10) binds beside it:
+    # stage runs/compiles/readbacks observed below feed tier selection.
+    from ballista_tpu.ops import aotcache, costmodel
 
     aotcache.configure(ctx.config)
+    costmodel.configure(ctx.config)
     # COUNT-over-LEFT-join as device membership counting (q13): the
     # per-probe counts plane replaces the join expansion entirely. A cheap
     # shape prescreen — non-matching aggregates fall through to the ladder
@@ -315,7 +358,16 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     if stage is False:
         return None
     try:
-        return stage.run(partition, ctx)
+        # the run cost is a cost-store observation keyed on stable stage
+        # identity (like the AOT cache), and the success is a recorded
+        # routing decision — predicted from the stage's own history, so the
+        # bench mispredict rate covers the aggregate path too
+        import hashlib
+
+        op = "stage.run|" + hashlib.sha1(stable.encode()).hexdigest()[:12]
+        with costmodel.timed(op, routing_op="stage"):
+            out = stage.run(partition, ctx)
+        return out
     except UnsupportedOnDevice:
         # permanently declined: free its pinned device entries and their
         # HBM-budget reservations before dropping the stage. Log WHY once —
@@ -327,11 +379,15 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         logging.getLogger("ballista.tpu").warning(
             "device stage permanently declined to host: %s", sys.exc_info()[1]
         )
-        from ballista_tpu.ops.runtime import release_stage_residency
+        from ballista_tpu.ops.runtime import (
+            record_routing,
+            release_stage_residency,
+        )
 
         release_stage_residency(stage)
         with _stage_cache_lock:
             _stage_cache[key] = False
+        record_routing("host", "stage")
         return host_fallback(reason)
 
 
@@ -347,7 +403,7 @@ def _compile_predicate(predicate, schema: pa.Schema):
         compiler = ExprCompiler(schema, dicts)
         cv = compiler.compile(predicate)
         if cv.kind != "bool":
-            decline("non-boolean predicate")
+            decline("non-boolean predicate")  # cold-path: compile-time shape check; the routing decision is recorded where the cached verdict is consumed (filter_batch)
         import jax
 
         from ballista_tpu.ops.jaxexpr import predicate_fn
@@ -384,6 +440,9 @@ def filter_batch(batch: pa.RecordBatch, predicate) -> Optional[pa.RecordBatch]:
             fill = False if npcol.dtype == np.bool_ else 0
             cols[idx] = jnp.asarray(pad_to(npcol, bucket, fill))
     except UnsupportedOnDevice as e:
+        from ballista_tpu.ops.runtime import record_routing
+
+        record_routing("host", "filter")
         return host_fallback(f"filter batch lowering: {e}")
     aux = [jnp.asarray(a) for a in compiler.build_aux()]
     # the full boolean mask rides d2h once per batch — account for it

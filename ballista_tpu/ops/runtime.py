@@ -13,7 +13,8 @@ TPU-first data discipline (SURVEY §7 "TPU operator lowering"):
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 import pyarrow as pa
@@ -692,8 +693,24 @@ def readback(x, rows: Optional[int] = None) -> np.ndarray:
     pass it explicitly when the row axis is not the trailing one. Every
     device-path np.asarray of a compiled-program result must go through
     here (or pair with record_readback) — enforced by
-    dev/analysis's readback-discipline pass."""
+    dev/analysis's readback-discipline pass.
+
+    With the cost model enabled (ISSUE 10), the transfer's wall time lands
+    in the cost store as a per-byte readback observation (bench
+    observability + groundwork for transfer-aware admission; no predictor
+    consults it yet). The producing computation is synced FIRST so the
+    timer measures the d2h transfer, not whatever async dispatch happens
+    to still be in flight."""
+    from ballista_tpu.ops import costmodel
+
+    t0 = None
+    if costmodel.enabled():
+        if hasattr(x, "block_until_ready"):
+            x.block_until_ready()
+        t0 = time.perf_counter()
     arr = np.asarray(x)
+    if t0 is not None and arr.nbytes:
+        costmodel.observe("readback", arr.nbytes, time.perf_counter() - t0)
     record_readback(
         rows if rows is not None else (arr.shape[-1] if arr.ndim else 1),
         arr.nbytes,
@@ -723,6 +740,10 @@ _join_reasons: Dict[str, int] = {}  # "path: reason" -> count; guarded-by: _join
 
 
 def record_join_path(path: str, reason: Optional[str] = None) -> None:
+    probe = getattr(_probe_tls, "probe", None)
+    if probe is not None:
+        probe.buf.append(("join_path", (path, reason)))
+        return
     with _join_lock:
         _join_paths[path] = _join_paths.get(path, 0) + 1
         if reason:
@@ -818,3 +839,195 @@ def serving_stats(reset: bool = False) -> Dict[str, int]:
         if reset:
             _serving.clear()
     return out
+
+
+# accumulated adaptive-routing decisions (ISSUE 10): every engine choice
+# the cost-model-aware ladder makes — device / host / split — lands here
+# with its predicted-vs-observed cost when a prediction existed, plus named
+# events (partial-offload splits, skew re-plans, build-side swaps, cost-
+# store health). bench.py reports the per-config `routing` block off this.
+# A decision whose observed cost deviates from its prediction by more than
+# costmodel.MISPREDICT_FACTOR either way counts as a mispredict; the
+# mispredict rate is the model's running honesty meter.
+_routing_lock = threading.Lock()
+# guarded-by: _routing_lock
+_routing = {
+    "engines": {},  # engine -> decision count
+    "events": {},  # event -> count (op:engine decision detail + named events)
+    "predicted_s": 0.0,
+    "observed_s": 0.0,
+    "predictions": 0,
+    "mispredicts": 0,
+}
+
+
+# speculative-attempt scope: the build-swap re-plan (ops/join.py) probes
+# the swapped shape by running the full device ladder on it, and only a
+# probe that produced a result becomes the decision — a failed probe is
+# followed by the planned-shape attempt, which records the real outcome.
+# Decision counters made inside a probe (record_routing / record_join_path)
+# therefore buffer in the probe and land only on commit; without this one
+# join would count a host decline AND the planned-side decision. Named
+# events (record_routing_event: retier, split_oracle_mismatch, ...) pass
+# through — they describe work/store mutations that genuinely happened.
+_probe_tls = threading.local()
+
+
+class _RoutingProbe:
+    def __init__(self) -> None:
+        self.buf: List[tuple] = []
+
+    def commit(self) -> None:
+        """Land the buffered decisions (call AFTER the with-block: the
+        probe's records ARE the decision). Replays through the public
+        recorders, so a still-active outer probe keeps buffering them."""
+        buf, self.buf = self.buf, []
+        for kind, args in buf:
+            if kind == "routing":
+                record_routing(*args)
+            elif kind == "trace":
+                record_decline_trace(*args)
+            else:
+                record_join_path(*args)
+
+
+def record_decline_trace(counter: str, message: str) -> None:
+    """Decline observability (tracing counter + debug log) that respects an
+    active routing probe: a decline inside a speculative attempt buffers
+    like the decision counters, so an uncommitted probe leaves no phantom
+    host-fallback trace for a join that actually ran on device."""
+    probe = getattr(_probe_tls, "probe", None)
+    if probe is not None:
+        probe.buf.append(("trace", (counter, message)))
+        return
+    import logging
+
+    from ballista_tpu.utils import tracing
+
+    tracing.incr(counter)
+    logging.getLogger("ballista.tpu").debug("%s", message)
+
+
+@contextmanager
+def routing_probe() -> Iterator[_RoutingProbe]:
+    """Buffer routing/join-path decision counters recorded in the body.
+    The caller commits them only when the probed attempt became the real
+    decision; an uncommitted probe's records are dropped."""
+    prev = getattr(_probe_tls, "probe", None)
+    probe = _RoutingProbe()
+    _probe_tls.probe = probe
+    try:
+        yield probe
+    finally:
+        _probe_tls.probe = prev
+
+
+def record_routing(engine: str, op: str = "",
+                   predicted_s: Optional[float] = None,
+                   observed_s: Optional[float] = None) -> None:
+    """Record one routing decision: which engine ran `op`, and (when the
+    cost model predicted) how the prediction held up. Cost totals
+    accumulate only when BOTH sides exist, so predicted_s and observed_s
+    stay comparable sums over the same decision set."""
+    from ballista_tpu.ops.costmodel import gross_mispredict
+
+    probe = getattr(_probe_tls, "probe", None)
+    if probe is not None:
+        probe.buf.append(("routing", (engine, op, predicted_s, observed_s)))
+        return
+    with _routing_lock:
+        _routing["engines"][engine] = _routing["engines"].get(engine, 0) + 1
+        if op:
+            k = f"{op}:{engine}"
+            _routing["events"][k] = _routing["events"].get(k, 0) + 1
+        if predicted_s is not None and observed_s is not None:
+            _routing["predictions"] += 1
+            _routing["predicted_s"] += float(predicted_s)
+            _routing["observed_s"] += float(observed_s)
+            if gross_mispredict(predicted_s, observed_s):
+                _routing["mispredicts"] += 1
+
+
+def record_routing_event(event: str, n: int = 1) -> None:
+    """Count a named routing event (split, skew_replan, join_build_swapped,
+    retier, cost_store_corrupt, ...)."""
+    with _routing_lock:
+        _routing["events"][event] = _routing["events"].get(event, 0) + int(n)
+
+
+def routing_stats(reset: bool = False) -> Dict[str, object]:
+    """Snapshot of accumulated routing decisions + events. mispredict_rate
+    is derived here so every consumer sums the accounting identically."""
+    with _routing_lock:
+        out = {
+            "engines": dict(_routing["engines"]),
+            "events": dict(_routing["events"]),
+            "predicted_s": _routing["predicted_s"],
+            "observed_s": _routing["observed_s"],
+            "predictions": _routing["predictions"],
+            "mispredicts": _routing["mispredicts"],
+        }
+        if reset:
+            _routing["engines"] = {}
+            _routing["events"] = {}
+            _routing["predicted_s"] = 0.0
+            _routing["observed_s"] = 0.0
+            _routing["predictions"] = 0
+            _routing["mispredicts"] = 0
+    out["mispredict_rate"] = (
+        out["mispredicts"] / out["predictions"] if out["predictions"] else 0.0
+    )
+    return out
+
+
+# -- chunked double-buffered h2d upload (ISSUE 10 satellite) ----------------
+# A persisted-layout warm start used to move each staged column to the
+# device as ONE bulk transfer: nothing overlaps a 9.6 GB h2d the way the
+# ingest pipeline overlaps prepare. Large arrays now go up in bounded
+# chunks with exactly one transfer in flight while the previous one is
+# timed to completion — later chunks (and the next column's host staging)
+# overlap earlier transfers, and the per-chunk timings land in the cost
+# store as the h2d observations (observe-only today, like readback: no
+# predictor consults the h2d rate yet).
+
+_H2D_CHUNK_BYTES = 64 << 20  # per-chunk transfer size
+_H2D_MIN_CHUNKED = 256 << 20  # arrays below this go as one piece
+
+
+def upload_array(arr: np.ndarray):
+    """Host->device transfer of one numpy array. Arrays past
+    _H2D_MIN_CHUNKED split along axis 0 into _H2D_CHUNK_BYTES chunks,
+    double-buffered (dispatch chunk j, then block on chunk j-1 and record
+    its h2d cost), and concatenate on device — bit-identical to the single
+    put, with a transient 2x HBM peak for this one array. Small arrays —
+    and every array while the cost model is off (the chunked path's extra
+    device copy and HBM peak are part of the adaptive tier, and its
+    observations would be discarded anyway) — keep the plain async
+    jnp.asarray dispatch."""
+    import jax.numpy as jnp
+
+    from ballista_tpu.ops import costmodel
+
+    nbytes = arr.nbytes
+    rows = arr.shape[0] if arr.ndim else 0
+    if not costmodel.enabled() or nbytes < _H2D_MIN_CHUNKED or rows < 2:
+        return jnp.asarray(arr)
+    row_bytes = max(1, nbytes // rows)
+    chunk_rows = max(1, _H2D_CHUNK_BYTES // row_bytes)
+    if chunk_rows >= rows:
+        return jnp.asarray(arr)
+    chunks = []
+    prev = prev_t0 = None
+    for lo in range(0, rows, chunk_rows):
+        t0 = time.perf_counter()
+        c = jnp.asarray(np.ascontiguousarray(arr[lo:lo + chunk_rows]))
+        if prev is not None:
+            prev.block_until_ready()
+            costmodel.observe("h2d", prev.nbytes,
+                              time.perf_counter() - prev_t0)
+        prev, prev_t0 = c, t0
+        chunks.append(c)
+    prev.block_until_ready()
+    costmodel.observe("h2d", prev.nbytes, time.perf_counter() - prev_t0)
+    record_routing_event("h2d_chunked")
+    return jnp.concatenate(chunks, axis=0)

@@ -90,6 +90,41 @@ def _topk_cover_L1(codes: np.ndarray, n_groups: int) -> Optional[int]:
     return L1
 
 
+# the general skew handler splits at most this many dominant groups to the
+# in-program segment fold; distributions where more groups blow the cover
+# are broad, not skewed, and keep the default chunking
+SKEW_MAX_DOMINANT = 64
+
+
+def skew_split_plan(codes: np.ndarray, n_groups: int) -> Optional[Tuple[int, int]]:
+    """General skew handler (ISSUE 10): the q10 monster-group fallback,
+    generalized. Called when the one-chunk-per-group cover fails, it
+    detects the dominant groups at run time — the few whose runs blow the
+    cover bounds — and picks the cover from the TAIL run distribution
+    instead: L1 covers every non-dominant run (those groups keep the
+    one-chunk fast path, an identity fold), the dominant runs split across
+    chunks and segment-fold in program (the existing tstep_fold machinery,
+    so bit-identity is the proven contract). Returns (L1, n_dominant) or
+    None when the distribution is not skewed (<= SKEW_MAX_DOMINANT
+    dominants cannot satisfy the bounds) — the caller then keeps the
+    default percentile chunking exactly as before."""
+    if n_groups <= 1:
+        return None
+    lens = np.sort(np.bincount(codes, minlength=n_groups))[::-1]
+    budget = max(4 * len(codes), 1 << 22)
+    for n_dom in range(1, min(SKEW_MAX_DOMINANT, n_groups - 1) + 1):
+        tail_max = int(lens[n_dom])
+        L1 = 8
+        while L1 < tail_max:
+            L1 <<= 1
+        if L1 > TOPK_MAX_L1:
+            continue  # even the tail needs a wider cover: more dominants
+        dom_chunks = int(np.sum(-(-lens[:n_dom] // L1)))
+        if (n_groups - n_dom + dom_chunks) * L1 <= budget:
+            return L1, n_dom
+    return None
+
+
 class TooManyGroups(UnsupportedOnDevice):
     """Internal signal: per-batch unrolled path declined on cardinality;
     run() retries with the sorted layout before giving up."""
@@ -277,14 +312,22 @@ def _upload_staged(staged: Dict, choices: Dict) -> Dict:
     choice per key and freeing each host tile right after its device copy
     exists — peak host memory holds one column in flight, not the whole
     stage. The (dev, lut) tuple is the single LUT encoding widen_cols
-    understands; both device paths must build it here."""
+    understands; both device paths must build it here.
+
+    Large tiles go through runtime.upload_array (ISSUE 10 satellite):
+    bounded chunks, double-buffered, so a persisted-layout warm start's
+    bulk transfer overlaps the next column's host staging the way the
+    ingest pipeline overlaps prepare — and the per-chunk timings land in
+    the cost store as h2d observations."""
     import jax.numpy as jnp
+
+    from ballista_tpu.ops.runtime import upload_array
 
     cols: Dict = {}
     for idx in list(staged):
         arr, lut, choice = staged.pop(idx)
         choices[idx] = choice
-        dev = jnp.asarray(arr)
+        dev = upload_array(arr)
         cols[idx] = dev if lut is None else (dev, jnp.asarray(lut))
     return cols
 
@@ -1237,6 +1280,28 @@ class FusedAggregateStage:
                     layout = SortedSegmentLayout(codes, n_groups, force_L1=cover_L1)
                 except UnsupportedOnDevice:
                     layout = None
+            elif ctx.config.tpu_cost_model():
+                # general skew handler (ISSUE 10): the cover failed because
+                # a few dominant groups blow its bounds. Split THEM to the
+                # in-program segment fold and keep every tail group on the
+                # one-chunk fast path, instead of degrading the whole
+                # partition to percentile chunking. Counted as a runtime
+                # re-plan; bit-identity rides the existing fold machinery.
+                skew = skew_split_plan(codes, n_groups)
+                if skew is not None:
+                    L1_tail, _n_dom = skew
+                    try:
+                        self._check_int_ranges(npcols, L1_tail)
+                        layout = SortedSegmentLayout(
+                            codes, n_groups, force_L1=L1_tail
+                        )
+                        from ballista_tpu.ops.runtime import (
+                            record_routing_event,
+                        )
+
+                        record_routing_event("skew_replan")
+                    except UnsupportedOnDevice:
+                        layout = None
             if layout is None:
                 layout = SortedSegmentLayout(codes, n_groups)
                 self._check_int_ranges(npcols, layout.L1)

@@ -126,6 +126,7 @@ class SpmdJoinExec(ExecutionPlan):
             return
         try:
             self._inline_host = False
+            self._mesh_cost = (None, None)
             out = self._execute_mesh(ctx)
             self.last_path = "host-inline" if self._inline_host else "mesh"
             tracing.incr(
@@ -133,9 +134,17 @@ class SpmdJoinExec(ExecutionPlan):
                 else "spmd.join_mesh"
             )
             if not self._inline_host:
-                from ballista_tpu.ops.runtime import record_join_path
+                from ballista_tpu.ops.runtime import (
+                    record_join_path,
+                    record_routing,
+                )
 
+                predicted, observed = self._mesh_cost
                 record_join_path("device")
+                record_routing(
+                    "device", "join.mesh",
+                    predicted_s=predicted, observed_s=observed,
+                )
         except Exception:
             import logging
             import sys
@@ -155,6 +164,9 @@ class SpmdJoinExec(ExecutionPlan):
                 f"mesh join: {exc}" if isinstance(exc, UnsupportedOnDevice)
                 else f"mesh join error: {type(exc).__name__}",
             )
+            from ballista_tpu.ops.runtime import record_routing
+
+            record_routing("host", "join.mesh")
             if not isinstance(exc, UnsupportedOnDevice):
                 logging.getLogger("ballista.spmd").warning(
                     "mesh join failed, host fallback: %s", exc
@@ -270,8 +282,10 @@ class SpmdJoinExec(ExecutionPlan):
         # step_aside: the join leaves the device entirely, there is no next
         # device rung — only bench's join_paths kind keeps the admission-
         # tier distinction
+        from ballista_tpu.ops import costmodel
         from ballista_tpu.ops.kernels import host_fallback, join_multiplicity_tier
 
+        costmodel.configure(ctx.config)
         width, why = join_multiplicity_tier(max_mult, n_dev * n_dev * C_p)
         if width is None:
             host_fallback(why)
@@ -283,6 +297,16 @@ class SpmdJoinExec(ExecutionPlan):
             mesh, n_dev, C_l * n_dev, C_p * n_dev, width,
             want_left_bitmap=join.join_type == JoinType.LEFT,
         )
+        # the mesh program's cost lands in the SAME store the single-chip
+        # ladder consults (ISSUE 10): one ledger, every device join path.
+        # The store is consulted, not just fed — a gross mispredict
+        # re-tiers the bucket exactly like the single-chip gather, so the
+        # mesh rate tracks the current machine too.
+        import time as _time
+
+        mesh_units = n_dev * n_dev * C_p * width
+        predicted = costmodel.predict("join.mesh", mesh_units)
+        t_mesh0 = _time.perf_counter()
         outs = program(
             jnp.asarray(lc), jnp.asarray(lr), jnp.asarray(pc_), jnp.asarray(pr)
         )
@@ -291,6 +315,12 @@ class SpmdJoinExec(ExecutionPlan):
         # matched build rows per probe slot [n_dev * B_p, width], -1 = no match
         matched = readback(outs[0], rows=outs[0].shape[0])
         recv_prow = readback(outs[1])  # [n_dev * B_p] int32, -1 = pad
+        dt_mesh = _time.perf_counter() - t_mesh0
+        costmodel.observe("join.mesh", mesh_units, dt_mesh)
+        costmodel.check_mispredict("join.mesh", mesh_units, predicted, dt_mesh)
+        # hand predicted/observed back to execute()'s decision record so
+        # mesh device decisions count toward the bench mispredict accounting
+        self._mesh_cost = (predicted, dt_mesh)
 
         # flatten probe-slot-major: pad/null slots have all-(-1) rows, so
         # their repeat count is 0 and they vanish from the selection
@@ -324,13 +354,19 @@ class SpmdJoinExec(ExecutionPlan):
         past the admission tiers, empty sides). Costs one collect + one
         join pass, like the broadcast join these plans had before SPMD
         co-partitioning; no shuffle materialization, no re-execution."""
-        from ballista_tpu.ops.runtime import record_join_path
+        from ballista_tpu.ops import costmodel
+        from ballista_tpu.ops.runtime import record_join_path, record_routing
         from ballista_tpu.physical.joinutil import join_indices, take_table
 
+        # every inline-host decline is one host routing decision, whatever
+        # the reason — recorded here so no caller can forget it
+        record_routing("host", "join.mesh")
         record_join_path(kind, reason or None)
         self._inline_host = True
         how = "inner" if self.subplan.join_type == JoinType.INNER else "left"
-        li, ri = join_indices(bcodes, pcodes, how)
+        with costmodel.timed("join.host", len(bcodes) + len(pcodes),
+                             engine="host", predictive=False):
+            li, ri = join_indices(bcodes, pcodes, how)
         lt = take_table(left, li)
         rt = take_table(right, ri)
         return pa.table(

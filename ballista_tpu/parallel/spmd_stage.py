@@ -230,7 +230,15 @@ class SpmdAggregateExec(ExecutionPlan):
             yield from self._execute_host(ctx)
             return
         try:
-            out = self._execute_mesh(ctx)
+            # mesh aggregate cost feeds the same store the single-chip
+            # ladder consults (ISSUE 10), keyed on this stage's identity;
+            # the decision lands in the routing accumulator either way
+            from ballista_tpu.ops import costmodel
+
+            costmodel.configure(ctx.config)
+            op = "mesh.agg|" + self.fingerprint()[:12]
+            with costmodel.timed(op, routing_op="mesh.agg"):
+                out = self._execute_mesh(ctx)
             self.last_path = "mesh"
             tracing.incr("spmd.mesh")
         except Exception:  # device decline of any kind -> host subplan
@@ -249,6 +257,9 @@ class SpmdAggregateExec(ExecutionPlan):
                         "mesh aggregation failed (stage %s), host fallback: %s",
                         fp, exc,
                     )
+            from ballista_tpu.ops.runtime import record_routing
+
+            record_routing("host", "mesh.agg")
             self.last_path = "host"
             yield from self._execute_host(ctx)
             return

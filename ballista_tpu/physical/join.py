@@ -104,8 +104,11 @@ class HashJoinExec(ExecutionPlan):
                 # the host oracle's semi_right/anti_right selections, so
                 # results are bit-identical. Declines (None, with a
                 # recorded reason) fall through to the host path.
+                from ballista_tpu.ops import aotcache, costmodel
                 from ballista_tpu.ops.join import device_membership_counts
 
+                aotcache.configure(ctx.config)
+                costmodel.configure(ctx.config)
                 counts = device_membership_counts(bcodes, pcodes)
                 if counts is not None:
                     keep = counts > 0 if self.join_type == JoinType.SEMI \
@@ -126,14 +129,23 @@ class HashJoinExec(ExecutionPlan):
         else:
             build = self._collect_build(self.left, ctx)
         probe = collect_partition(self.right, partition, ctx)
+        device_declined = False
         if (self.join_type == JoinType.INNER and ctx.backend == "tpu"
                 and ctx.config.tpu_device_join()):
             # device M:N join: sorted paired binary search + bounded-width
             # gather on TPU, duplicate build keys included; declines (None,
-            # always with a recorded reason) fall through to the host join
+            # always with a recorded reason) fall through to the host join.
+            # The cost model (ISSUE 10) rides the config: partial offload,
+            # extended tiers, and build-side switching on observed
+            # cardinality misestimates — all bit-identical to the host.
+            from ballista_tpu.ops import aotcache, costmodel
             from ballista_tpu.ops.join import try_device_inner_join
 
-            res = try_device_inner_join(build, probe, left_keys, right_keys)
+            aotcache.configure(ctx.config)
+            costmodel.configure(ctx.config)
+            res = try_device_inner_join(
+                build, probe, left_keys, right_keys, config=ctx.config
+            )
             if res is not None:
                 left_idx, right_idx = res
                 left_out = take_table(build, left_idx)
@@ -142,6 +154,7 @@ class HashJoinExec(ExecutionPlan):
                 out = pa.table(cols, schema=self._schema)
                 yield from batch_table(out, ctx.batch_size)
                 return
+            device_declined = True
         bcodes, pcodes = combined_key_codes(
             [build.column(k) for k in left_keys],
             [probe.column(k) for k in right_keys],
@@ -161,7 +174,17 @@ class HashJoinExec(ExecutionPlan):
                 f"{how} join requires co-partitioned inputs or a "
                 "single-partition probe side"
             )
-        left_idx, right_idx = join_indices(bcodes, pcodes, how)
+        if device_declined:
+            # the host join after a device decline is the device's
+            # alternative cost: measure it so tier selection learns what
+            # host-wholesale actually costs at this scale
+            from ballista_tpu.ops import costmodel
+
+            with costmodel.timed("join.host", len(bcodes) + len(pcodes),
+                                 engine="host", predictive=False):
+                left_idx, right_idx = join_indices(bcodes, pcodes, how)
+        else:
+            left_idx, right_idx = join_indices(bcodes, pcodes, how)
         left_out = take_table(build, left_idx)
         right_out = take_table(probe, right_idx)
         cols = list(left_out.columns) + list(right_out.columns)

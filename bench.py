@@ -12,7 +12,10 @@ Prints ONE JSON line:
                host_fallback counts with decline reasons), and "recovery"
                (retry / lineage-recompute / rpc-retry / chaos-injection
                event totals — nonzero under ballista.chaos.* or real
-               faults)]}
+               faults), and "routing" (adaptive-execution decisions:
+               engine choice counts, predicted vs observed seconds,
+               mispredict rate, partial-offload splits, skew re-plans —
+               ops/costmodel.py)]}
 
 Reference baseline context: the reference publishes no numbers
 (BASELINE.md); the denominator here is this repo's own host Arrow path —
@@ -339,6 +342,37 @@ def _recovery_snapshot() -> dict | None:
     return s or None
 
 
+def _routing_snapshot() -> dict | None:
+    """Drain the adaptive-routing accumulator (ops/runtime.py): every
+    engine decision the cost-model-aware ladder made (device / host /
+    split), predicted-vs-observed seconds over the decisions that carried
+    a prediction, the derived mispredict rate, and the named re-planning
+    events (partial-offload splits, skew re-plans, build-side swaps,
+    re-tiers, cost-store health). Raw decision TOTALS like the recovery
+    block — routing is driven by shapes and store warmth, not the query
+    loop. None when no routing decision was made (host backend)."""
+    try:
+        from ballista_tpu.ops.runtime import routing_stats
+
+        s = routing_stats(reset=True)
+    except Exception:
+        return None
+    if not s["engines"] and not s["events"]:
+        return None
+    events = s["events"]
+    return {
+        "engines": s["engines"],
+        "predictions": s["predictions"],
+        "mispredicts": s["mispredicts"],
+        "mispredict_rate": round(s["mispredict_rate"], 4),
+        "predicted_s": round(s["predicted_s"], 4),
+        "observed_s": round(s["observed_s"], 4),
+        "splits": events.get("split", 0),
+        "skew_replans": events.get("skew_replan", 0),
+        "events": events,
+    }
+
+
 def _ingest_snapshot() -> dict | None:
     """Drain the ingest-timing accumulator (ops/runtime.py): scan/encode/
     upload seconds and the overlap fraction of the stage prepares since the
@@ -378,10 +412,12 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         _readback_snapshot()  # drain: attribute readbacks to the timed runs
         _join_snapshot()  # drain: attribute join paths to the timed runs
         _recovery_snapshot()  # drain: attribute recovery events likewise
+        _routing_snapshot()  # drain: attribute routing decisions likewise
         t = min(run_once("tpu", sql, sf) for _ in range(iters))
         readback = _per_query(_readback_snapshot(), iters)
         join_paths = _join_snapshot(iters)
         recovery = _recovery_snapshot()
+        routing = _routing_snapshot()
         run_once("cpu", sql, sf)
         c = min(run_once("cpu", sql, sf) for _ in range(iters))
     except Exception as e:
@@ -419,6 +455,13 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
     if recovery is not None:
         row["recovery"] = recovery
         print(f"[recovery] {name} sf={sf}: {recovery} (event totals)",
+              file=sys.stderr)
+    if routing is not None:
+        row["routing"] = routing
+        print(f"[routing] {name} sf={sf}: engines={routing['engines']} "
+              f"mispredict_rate={routing['mispredict_rate']} "
+              f"splits={routing['splits']} "
+              f"skew_replans={routing['skew_replans']} (decision totals)",
               file=sys.stderr)
     print(f"[config] {name} sf={sf}: tpu={row['tpu_ms']}ms "
           f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x", file=sys.stderr)
@@ -775,7 +818,81 @@ def _latency_scenario() -> dict | None:
         cluster.shutdown()
 
 
+def _routing_scenario() -> dict | None:
+    """Adaptive-execution smoke (ISSUE 10): an in-process skewed join whose
+    build-key multiplicity sits past the static admission ladder, run cold,
+    warm, and with the cost model off. CI asserts off the returned record
+    that the `routing` block appears, that the cold run SPLIT at the tier
+    boundary instead of declining wholesale, that every configuration's
+    result is bit-identical to the host backend, and that the mispredict
+    accounting sums (mispredicts <= predictions <= total decisions;
+    mispredict_rate == mispredicts/predictions). Device-free images run
+    this fine — the device path runs on whatever jax platform is up."""
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.ops.runtime import routing_stats
+
+    rng = np.random.default_rng(7)
+    # one monster key past the top static tier (256) + a unique tail: the
+    # shape partial offload exists for
+    nb = 2000
+    bkeys = np.concatenate([np.arange(nb), np.full(400, nb // 2)])
+    rng.shuffle(bkeys)
+    build = pa.table({"bk": pa.array(bkeys, type=pa.int64()),
+                      "bv": pa.array(np.arange(len(bkeys), dtype=np.int64))})
+    # guaranteed monster probes: the split shape must not ride rng luck
+    pkeys = np.concatenate([rng.integers(0, nb + 200, 4000),
+                            np.full(3, nb // 2)])
+    probe = pa.table({"pk": pa.array(pkeys, type=pa.int64()),
+                      "pv": pa.array(np.arange(len(pkeys), dtype=np.int64))})
+
+    def run(backend: str, cm: str, store_dir: str, iters: int = 1):
+        ctx = ExecutionContext(BallistaConfig({
+            "ballista.executor.backend": backend,
+            "ballista.tpu.cost_model": cm,
+            "ballista.tpu.cost_model_dir": store_dir,
+        }))
+        ctx.register_record_batches("b", build, n_partitions=1)
+        ctx.register_record_batches("p", probe, n_partitions=1)
+        df = ctx.table("b").join(ctx.table("p"), ["bk"], ["pk"], how="inner")
+        # iters > 1 warms the gather/host-cost buckets past
+        # costmodel.MIN_OBSERVATIONS so later decisions carry predictions
+        # (every iteration re-executes the join; results must all agree)
+        outs = [df.collect().to_pylist() for _ in range(iters)]
+        assert all(o == outs[0] for o in outs[1:])
+        return outs[0]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        costmodel.reset(clear_dir=True)
+        routing_stats(reset=True)  # drain: attribute decisions to the runs
+        host = run("cpu", "false", "")
+        cold = run("tpu", "true", tmp, iters=6)
+        costmodel.flush()
+        costmodel.reset()  # fresh process simulation: reload from disk
+        warm = run("tpu", "true", tmp, iters=2)
+        off = run("tpu", "false", "")
+        routing = _routing_snapshot()
+    if routing is None:
+        print("[routing] smoke made no routing decisions", file=sys.stderr)
+        return None
+    routing["bit_identical"] = host == cold == warm == off
+    print(f"[routing] smoke: engines={routing['engines']} "
+          f"splits={routing['splits']} "
+          f"bit_identical={routing['bit_identical']}", file=sys.stderr)
+    return routing
+
+
 def main() -> None:
+    if os.environ.get("BENCH_ROUTING_ONLY"):
+        # adaptive-execution smoke only: runs without a reachable device
+        print(json.dumps({"routing": _routing_scenario()}))
+        return
     if os.environ.get("BENCH_LATENCY_ONLY"):
         # serving-tier scenario only: runs without a reachable device
         print(json.dumps({"latency": _latency_scenario()}))
@@ -798,8 +915,10 @@ def main() -> None:
     run_once("tpu", q1)
     headline_ingest = _ingest_snapshot()
     _readback_snapshot()  # drain
+    _routing_snapshot()  # drain
     tpu_dt = min(run_once("tpu", q1) for _ in range(3))
     headline_readback = _per_query(_readback_snapshot(), 3)
+    headline_routing = _routing_snapshot()
     run_once("cpu", q1)
     cpu_dt = min(run_once("cpu", q1) for _ in range(3))
 
@@ -845,6 +964,8 @@ def main() -> None:
         result["ingest"] = headline_ingest
     if headline_readback is not None:
         result["readback"] = headline_readback
+    if headline_routing is not None:
+        result["routing"] = headline_routing
     if time.monotonic() - _T_START <= MAX_SECONDS:
         try:
             mt = _multitenant_scenario()

@@ -41,6 +41,7 @@ def _load_rules() -> None:
         rules_failure,
         rules_guarded,
         rules_readback,
+        rules_routing,
         rules_tracer,
     )
 

@@ -91,6 +91,42 @@ JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_latency_tier.py
 
+# strict gate on adaptive execution (ISSUE 10): the measured cost model —
+# store roundtrip/corruption/fingerprint-mismatch safety, evidence-gated
+# extended-tier admission with the static ladder as cold-start prior and
+# hard cap, partial-offload splits bit-identical to the host oracle,
+# mispredict-driven re-tiering, the general skew handler, build-side
+# swapping, the chunked h2d upload, the device-join AOT disk tier, and
+# the routing fuzz slice (cold / warm / off / adversarial store entries,
+# results bit-identical in every configuration).
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_costmodel.py \
+    "tests/test_fuzz_device.py::test_fuzz_routing"
+
+# adaptive-execution bench smoke (ISSUE 10): the skewed join past the
+# static ladder must SPLIT at the tier boundary instead of declining
+# wholesale, results bit-identical across cold/warm/off, and the routing
+# block's mispredict accounting must sum (mispredicts <= predictions <=
+# total decisions; rate == mispredicts/predictions).
+JAX_PLATFORMS=cpu BENCH_ROUTING_ONLY=1 python bench.py \
+    > /tmp/_ballista_routing_smoke.json
+python - /tmp/_ballista_routing_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["routing"]
+assert rec is not None, "routing smoke returned no record"
+assert rec["bit_identical"], "routing changed results"
+assert rec["splits"] >= 1, f"no partial-offload split: {rec}"
+assert rec["engines"].get("split", 0) >= 1, rec
+total = sum(rec["engines"].values())
+assert 0 <= rec["mispredicts"] <= rec["predictions"] <= total, rec
+want = rec["mispredicts"] / rec["predictions"] if rec["predictions"] else 0.0
+assert abs(rec["mispredict_rate"] - want) < 1e-4, rec
+assert rec["events"].get("split", 0) == rec["splits"], rec
+assert rec["skew_replans"] == rec["events"].get("skew_replan", 0), rec
+print("routing smoke OK:", {k: rec[k] for k in
+                            ("engines", "mispredict_rate", "splits")})
+PY
+
 # latency harness smoke (ISSUE 8): tiny QPS, 2s budget per level — the
 # p50/p99 + time-to-first-batch + dispatch/compile-counter pipeline is
 # exercised end-to-end on CPU images even though the absolute numbers only

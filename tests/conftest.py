@@ -35,6 +35,25 @@ import pytest
 import ballista_tpu.config as _config
 
 _config.DEFAULT_SETTINGS[_config.BALLISTA_TPU_LAYOUT_CACHE_DIR] = ""
+# Same for the ISSUE 10 cost store: adaptive routing stays ON (the
+# structural paths — splits, skew re-plans, build swaps — are exercised by
+# the whole suite) but observations never persist across test runs.
+_config.DEFAULT_SETTINGS[_config.BALLISTA_TPU_COST_MODEL_DIR] = ""
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cost_store():
+    """The in-memory cost store is process-global and configure() only
+    clears it on a DIRECTORY change — with the dir pinned to "" above,
+    observations would otherwise accumulate across every test in the
+    process, and a test's routing (extended tiers, predictions) would
+    depend on which device joins happened to run before it. Dropping the
+    store per test keeps routing deterministic under any ordering/subset;
+    tests that want a warm store seed it explicitly."""
+    from ballista_tpu.ops import costmodel
+
+    costmodel.reset(clear_dir=True)
+    yield
 
 
 @pytest.fixture

@@ -1,8 +1,10 @@
 # ballista-lint: path=ballista_tpu/ops/fixture_decline_good.py
-"""GOOD: reasoned declines through the canonical signals."""
+"""GOOD: reasoned declines through the canonical signals (and, since
+ISSUE 10, paired with a routing observation so the bench routing block
+counts the host decision)."""
 
 from ballista_tpu.ops.kernels import host_fallback
-from ballista_tpu.ops.runtime import UnsupportedOnDevice
+from ballista_tpu.ops.runtime import UnsupportedOnDevice, record_routing
 
 
 def lower(col):
@@ -15,4 +17,5 @@ def entry(col):
     try:
         return lower(col)
     except UnsupportedOnDevice as e:
+        record_routing("host", "fixture")
         return host_fallback(f"fixture lowering: {e}")

@@ -559,3 +559,81 @@ def test_fuzz_float_extrema_minmax(tmp_path, seed):
                     ), (sql, name, a, b)
                 else:
                     assert a == b, (sql, name, a, b)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_routing(tmp_path, seed):
+    """Adaptive-execution replay (ISSUE 10): the duplicate-key join sweep
+    re-run with the cost model forced cold, warm, off, and fed seeded
+    ADVERSARIAL cost entries (absurd rates both directions). Routing may
+    differ — device, split, extended tier, host — but results must be
+    bit-identical in every configuration: the cost model changes where a
+    partition runs, never what it returns. Own rng streams (18000+ data,
+    19000+ probe/adversary), so every baseline stream above stays
+    byte-identical."""
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.ops.kernels import JOIN_EXTENDED_TIERS
+
+    rng = np.random.default_rng(18000 + seed)
+    prng = np.random.default_rng(19000 + seed)
+    _fresh()
+    costmodel.reset(clear_dir=True)
+    shape = str(rng.choice(["zipf", "all_dup", "monster", "uniform"]))
+    bkeys = _dup_key_build(rng, shape)
+    nb = len(bkeys)
+    bnull = rng.random(nb) < 0.05
+    build = pa.table({
+        "bk": pa.array(
+            [None if isnull else int(v) for v, isnull in zip(bkeys, bnull)],
+            type=pa.int64(),
+        ),
+        "bv": pa.array(np.round(rng.uniform(-100, 100, nb), 3)),
+    })
+    np_rows = int(prng.integers(500, 6000))
+    pkeys = prng.integers(-1, int(bkeys.max()) + 20, np_rows)
+    probe = pa.table({
+        "pk": pa.array(
+            [None if v < 0 else int(v) for v in pkeys], type=pa.int64()
+        ),
+        "pv": pa.array(np.round(prng.uniform(0, 50, np_rows), 3)),
+    })
+
+    def run(backend, model, store_dir):
+        ctx = ExecutionContext(BallistaConfig({
+            "ballista.executor.backend": backend,
+            "ballista.tpu.cost_model": model,
+            "ballista.tpu.cost_model_dir": store_dir,
+        }))
+        ctx.register_record_batches("b", build, n_partitions=1)
+        ctx.register_record_batches("p", probe, n_partitions=1)
+        df = ctx.table("b").join(ctx.table("p"), ["bk"], ["pk"], how="inner")
+        return df.collect().to_pylist()
+
+    store = str(tmp_path / "costs")
+    try:
+        baseline = run("cpu", "false", "")
+        out_off = run("tpu", "false", "")
+        out_cold = run("tpu", "true", store)
+        costmodel.flush()
+        costmodel.reset()  # fresh-process simulation: reload from disk
+        out_warm = run("tpu", "true", store)
+        # adversarial entries: absurd rates in a prng-chosen direction,
+        # covering every op the join ladder predicts from. The run MUST
+        # keep the same store dir — a dir change in configure() clears the
+        # in-memory store and would silently wipe the seeds
+        fast, slow = (1e-12, 100.0)
+        if prng.random() < 0.5:
+            fast, slow = slow, fast
+        for tier in JOIN_EXTENDED_TIERS:
+            costmodel.seed("join.gather", 4096 * tier, fast)
+        costmodel.seed("join.gather", 4096, fast)
+        costmodel.seed("join.host", nb + np_rows, slow, engine="host")
+        assert costmodel.snapshot(), "adversarial seeds must be installed"
+        out_adv = run("tpu", "true", store)
+        assert costmodel.snapshot(), "seeds were wiped before the run"
+        assert baseline == out_off == out_cold == out_warm == out_adv, (
+            shape, seed,
+        )
+    finally:
+        costmodel.reset(clear_dir=True)
+        _fresh()

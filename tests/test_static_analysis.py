@@ -30,6 +30,7 @@ RULES = [
     "guarded-by",
     "decline-discipline",
     "failure-discipline",
+    "routing-discipline",
 ]
 
 
@@ -181,6 +182,34 @@ def test_failure_rule_push_site_fixture_pair():
     assert any("string literal" in m for m in findings), findings
     good = analyze_file(str(FIXTURES / "failure_push_good.py"))
     assert good == [], "\n".join(f.format() for f in good)
+
+
+def test_routing_rule_fixture_pair():
+    """ISSUE 10 satellite: a decline-helper call with no routing
+    observation in scope and no cold-path annotation fails lint — a
+    FOREIGN .observe() method included (only the qualified
+    costmodel.observe counts); the recorder-paired and annotated shapes
+    are clean, covering each accepted recorder (record_routing /
+    record_routing_event / record_join_path / costmodel.observe)."""
+    findings = [
+        f for f in analyze_file(str(FIXTURES / "routing_bad.py"))
+        if f.rule == "routing-discipline"
+    ]
+    assert len(findings) == 3, "\n".join(f.format() for f in findings)
+    assert {f.line for f in findings} == {10, 14, 19}
+    good = analyze_file(str(FIXTURES / "routing_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
+def test_routing_rule_skips_helper_definitions():
+    """The canonical helpers in ops/kernels.py ARE the decline channel;
+    their own bodies must not be flagged (and the production kernels module
+    stays clean under the rule)."""
+    findings = [
+        f for f in analyze_file(str(REPO / "ballista_tpu" / "ops" / "kernels.py"))
+        if f.rule == "routing-discipline"
+    ]
+    assert findings == [], "\n".join(f.format() for f in findings)
 
 
 def test_failure_rule_sites_track_chaos_registry():
