@@ -47,6 +47,123 @@ class _CachedResultLost(BallistaError):
         self.job_id = job_id
 
 
+class _StatusWatch:
+    """Server-push job-status subscription (ISSUE 11): a reader thread
+    drains one SubscribeJobStatus stream into a queue; next() blocks until
+    a fresh status lands (or the timeout passes) — which is what removes
+    the 5ms-floor polling gap from job completion latency. Degrades
+    cleanly: any stream failure (scheduler restart, push disabled,
+    pre-ISSUE-11 scheduler answering UNIMPLEMENTED) just flips alive() off
+    and the caller's poll loop takes over."""
+
+    def __init__(self, client, job_id: str) -> None:
+        import queue as _queue
+        import threading
+
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._call = None
+        self._down = False
+        try:
+            self._call = client.subscribe_job_status(
+                pb.GetJobStatusParams(job_id=job_id)
+            )
+        except Exception:
+            self._down = True
+            return
+        from ballista_tpu.ops.runtime import record_serving
+
+        record_serving("status_push_subscribed")
+        threading.Thread(
+            target=self._read, daemon=True, name="status-watch"
+        ).start()
+
+    def _read(self) -> None:
+        try:
+            for res in self._call:
+                self._q.put(res.status)
+        except Exception:
+            pass
+        finally:
+            self._q.put(None)  # stream over (terminal served, or dropped)
+
+    def next(self, timeout: float):
+        """Next pushed JobStatus, or None when the timeout passed (caller
+        falls through to a safety poll) or the stream ended (alive() is
+        then False and the caller's poll loop owns the job)."""
+        import queue as _queue
+
+        if self._down:
+            return None
+        try:
+            st = self._q.get(timeout=max(0.0, timeout))
+        except _queue.Empty:
+            return None
+        if st is None:
+            self._down = True
+            from ballista_tpu.ops.runtime import record_serving
+
+            record_serving("status_push_closed")
+            return None
+        from ballista_tpu.ops.runtime import record_serving
+
+        record_serving("status_push")
+        return st
+
+    def alive(self) -> bool:
+        return not self._down
+
+    def close(self) -> None:
+        if self._call is not None:
+            try:
+                self._call.cancel()
+            except Exception:
+                pass
+
+
+class _JobStatusSource:
+    """Watch-or-poll job-status acquisition (ISSUE 11): ONE implementation
+    of the push/poll contract shared by every status-consuming loop —
+    the push subscription (when `ballista.client.push_status` is on), the
+    safety-poll fallback, and the adaptive pure-poll pacing. next() blocks
+    up to POLL_INTERVAL on a live stream (a pushed transition returns the
+    instant the scheduler writes it) and polls otherwise, sleeping the
+    adaptive backoff between successive pure polls only."""
+
+    def __init__(self, client, config, job_id: str) -> None:
+        self._client = client
+        self._job_id = job_id
+        self._watch = (
+            _StatusWatch(client, job_id) if config.push_status() else None
+        )
+        self._interval = POLL_INTERVAL_MIN
+        self._polled = False
+
+    def next(self, deadline: float) -> pb.JobStatus:
+        """The next JobStatus before `deadline` — pushed when the stream
+        is live, polled otherwise (also the safety net when a live stream
+        stays silent for a full POLL_INTERVAL)."""
+        if self._watch is not None and self._watch.alive():
+            status = self._watch.next(
+                min(POLL_INTERVAL, max(0.0, deadline - time.time()))
+            )
+            if status is not None:
+                return status
+        elif self._polled:
+            # pure-poll pacing (push disabled or stream down) between
+            # successive polls; with a live watch, next() above already
+            # blocked waiting for the change
+            time.sleep(self._interval)
+            self._interval = min(self._interval * 2, POLL_INTERVAL)
+        self._polled = True
+        return self._client.get_job_status(
+            pb.GetJobStatusParams(job_id=self._job_id)
+        ).status
+
+    def close(self) -> None:
+        if self._watch is not None:
+            self._watch.close()
+
+
 class BallistaContext(ExecutionContext):
     """Client context talking to a remote scheduler (ref BallistaContext::remote)."""
 
@@ -181,6 +298,11 @@ class BallistaContext(ExecutionContext):
         from ballista_tpu.ops.runtime import record_recovery, record_serving
 
         deadline = time.time() + timeout
+        # push-status source (ISSUE 11): each status transition — every
+        # new partial_location included — arrives the moment the scheduler
+        # writes it, with the adaptive poll as the automatic safety net
+        # (cooldown re-fetches, stream drops, schedulers without the RPC)
+        source = _JobStatusSource(self._client, self.config, job_id)
         committed: Dict[int, list] = {}  # partition -> batches (not yet yielded)
         done: set = set()  # partitions committed (incl. already yielded)
         # partition -> ((executor id, path), failure time) of a location
@@ -191,74 +313,78 @@ class BallistaContext(ExecutionContext):
         failed_locs: Dict[int, tuple] = {}
         FAILED_LOC_COOLDOWN = 0.5
         next_yield = 0
-        interval = POLL_INTERVAL_MIN
-        while True:
-            if time.time() > deadline:
-                raise ExecutionError(f"job {job_id} timed out after {timeout}s")
-            status = self._client.get_job_status(
-                pb.GetJobStatusParams(job_id=job_id)
-            ).status
-            which = status.WhichOneof("status")
-            if which == "failed":
-                raise ExecutionError(f"job {job_id} failed: {status.failed.error}")
-            total = None
-            if which == "completed":
-                locs = list(status.completed.partition_location)
-                total = len(locs)
-            elif which == "running":
-                locs = list(status.running.partial_location)
-            else:
-                locs = []
-            for loc in locs:
-                p = loc.partition_id.partition_id
-                sig = (loc.executor_meta.id, loc.path)
-                if p in done:
-                    continue
-                prior = failed_locs.get(p)
-                if (
-                    prior is not None
-                    and prior[0] == sig
-                    and time.time() - prior[1] < FAILED_LOC_COOLDOWN
-                ):
-                    # a known-dead location the scheduler has not replaced
-                    # yet (a stale status snapshot can republish it for a
-                    # few polls); retried after the cooldown either way
-                    continue
-                try:
-                    batches = self._fetch_partition_batches(loc)
-                except ShuffleFetchError as e:
-                    result = self._client.report_lost_partition(
-                        pb.ReportLostPartitionParams(
-                            job_id=job_id,
-                            executor_id=e.executor_id,
-                            stage_id=e.stage_id,
-                            partition_id=e.map_partition,
-                            path=e.path,
-                        )
+        try:
+            while True:
+                if time.time() > deadline:
+                    raise ExecutionError(
+                        f"job {job_id} timed out after {timeout}s"
                     )
-                    if not result.restarted:
-                        if which == "completed" and status.completed.cached:
-                            raise _CachedResultLost(job_id) from e
-                        raise
-                    record_recovery("result_fetch_restarted")
-                    # keep fetching the OTHER listed partitions this round
-                    # (one dead location must not starve the rest); this
-                    # one retries after the cooldown / on a fresh location
-                    failed_locs[p] = (sig, time.time())
-                    continue
-                failed_locs.pop(p, None)
-                committed[p] = batches
-                done.add(p)
-                if which == "running":
-                    record_serving("stream_partition_early")
-            while next_yield in committed:
-                for batch in committed.pop(next_yield):
-                    yield batch
-                next_yield += 1
-            if total is not None and next_yield >= total:
-                return
-            time.sleep(interval)
-            interval = min(interval * 2, POLL_INTERVAL)
+                status = source.next(deadline)
+                which = status.WhichOneof("status")
+                if which == "failed":
+                    raise ExecutionError(
+                        f"job {job_id} failed: {status.failed.error}"
+                    )
+                total = None
+                if which == "completed":
+                    locs = list(status.completed.partition_location)
+                    total = len(locs)
+                elif which == "running":
+                    locs = list(status.running.partial_location)
+                else:
+                    locs = []
+                for loc in locs:
+                    p = loc.partition_id.partition_id
+                    sig = (loc.executor_meta.id, loc.path)
+                    if p in done:
+                        continue
+                    prior = failed_locs.get(p)
+                    if (
+                        prior is not None
+                        and prior[0] == sig
+                        and time.time() - prior[1] < FAILED_LOC_COOLDOWN
+                    ):
+                        # a known-dead location the scheduler has not
+                        # replaced yet (a stale status snapshot can
+                        # republish it for a few polls); retried after the
+                        # cooldown either way
+                        continue
+                    try:
+                        batches = self._fetch_partition_batches(loc)
+                    except ShuffleFetchError as e:
+                        result = self._client.report_lost_partition(
+                            pb.ReportLostPartitionParams(
+                                job_id=job_id,
+                                executor_id=e.executor_id,
+                                stage_id=e.stage_id,
+                                partition_id=e.map_partition,
+                                path=e.path,
+                            )
+                        )
+                        if not result.restarted:
+                            if which == "completed" and status.completed.cached:
+                                raise _CachedResultLost(job_id) from e
+                            raise
+                        record_recovery("result_fetch_restarted")
+                        # keep fetching the OTHER listed partitions this
+                        # round (one dead location must not starve the
+                        # rest); this one retries after the cooldown / on
+                        # a fresh location
+                        failed_locs[p] = (sig, time.time())
+                        continue
+                    failed_locs.pop(p, None)
+                    committed[p] = batches
+                    done.add(p)
+                    if which == "running":
+                        record_serving("stream_partition_early")
+                while next_yield in committed:
+                    for batch in committed.pop(next_yield):
+                        yield batch
+                    next_yield += 1
+                if total is not None and next_yield >= total:
+                    return
+        finally:
+            source.close()
 
     def _fetch_partition_batches(self, loc: pb.PartitionLocation) -> list:
         """One result partition as a committed batch list, streamed over
@@ -364,19 +490,25 @@ class BallistaContext(ExecutionContext):
             return pa.concat_tables(tables).cast(schema)
 
     def _wait_for_job(self, job_id: str, timeout: float) -> pb.JobStatus:
+        """Wait for a terminal status — via the SubscribeJobStatus push
+        stream when enabled (the completion arrives the instant the
+        scheduler writes it, no polling floor), with the adaptive poll as
+        the automatic fallback whenever the stream is down or refused."""
         deadline = time.time() + timeout
-        interval = POLL_INTERVAL_MIN
-        while time.time() < deadline:
-            result = self._client.get_job_status(pb.GetJobStatusParams(job_id=job_id))
-            status = result.status
-            which = status.WhichOneof("status")
-            if which == "completed":
-                return status
-            if which == "failed":
-                raise ExecutionError(f"job {job_id} failed: {status.failed.error}")
-            time.sleep(interval)
-            interval = min(interval * 2, POLL_INTERVAL)
-        raise ExecutionError(f"job {job_id} timed out after {timeout}s")
+        source = _JobStatusSource(self._client, self.config, job_id)
+        try:
+            while time.time() < deadline:
+                status = source.next(deadline)
+                which = status.WhichOneof("status")
+                if which == "completed":
+                    return status
+                if which == "failed":
+                    raise ExecutionError(
+                        f"job {job_id} failed: {status.failed.error}"
+                    )
+            raise ExecutionError(f"job {job_id} timed out after {timeout}s")
+        finally:
+            source.close()
 
     def _fetch_partition(self, loc: pb.PartitionLocation) -> pa.Table:
         from ballista_tpu.client.flight import BallistaClient

@@ -120,6 +120,32 @@ BALLISTA_RESULT_CACHE_TTL_S = "ballista.cache.results.ttl_s"
 # planning output is content-keyed (fingerprint sans mtimes), so N tenants
 # submitting the same dashboard query plan it once.
 BALLISTA_PLAN_CACHE = "ballista.cache.plans"
+# per-tenant latency SLO deadlines (ISSUE 11): "alice:250,bob:2000" gives
+# alice's jobs a 250ms target. Feeds admission ordering — a tenant whose
+# oldest pending job has blown (or is past) its deadline is visited BEFORE
+# the weighted fair-share order (deadline-aware fair share), and a job
+# completing past its deadline counts an `slo_misses` speculation event.
+# Unlisted tenants carry no SLO and keep the pure fair-share order.
+BALLISTA_TENANT_SLO_MS = "ballista.tenant.slo_ms"
+# -- speculative execution (ISSUE 11, scheduler/state.py) -------------------
+# cost-model straggler detection: when a RUNNING task's elapsed time
+# exceeds `multiplier` x its predicted cost (ops/costmodel.py task.run
+# rates, warmed by sibling completions) AND the minimum-runtime floor, the
+# scheduler dispatches a duplicate attempt to a DIFFERENT executor through
+# the normal assignment + ledger path. First completion wins; the losing
+# attempt's report is dropped by the stale-attempt guard. Results are
+# bit-identical with speculation on or off.
+BALLISTA_SPECULATION = "ballista.speculation"
+BALLISTA_SPECULATION_MULTIPLIER = "ballista.speculation.multiplier"
+# floor below which a task never speculates (cheap tasks finish before a
+# duplicate could help; this is also why fault-free runs launch nothing
+# under the defaults)
+BALLISTA_SPECULATION_MIN_RUNTIME_MS = "ballista.speculation.min_runtime_ms"
+# client-side server-push job-status notifications (ISSUE 11 satellite): a
+# server-streaming SubscribeJobStatus RPC mirroring SubscribeWork replaces
+# the 5ms-floor adaptive status poll on the wait/stream paths; the poll
+# stays as the automatic fallback whenever the stream is down or refused.
+BALLISTA_PUSH_STATUS = "ballista.client.push_status"
 # -- low-latency serving tier (ISSUE 8) -------------------------------------
 # push-based task dispatch: executors open a server-streaming SubscribeWork
 # stream and the scheduler pushes TaskDefinitions the moment assignment
@@ -172,6 +198,12 @@ BALLISTA_TPU_COST_MODEL_DIR = "ballista.tpu.cost_model_dir"
 BALLISTA_CHAOS_SEED = "ballista.chaos.seed"
 BALLISTA_CHAOS_RATE = "ballista.chaos.rate"
 BALLISTA_CHAOS_SITES = "ballista.chaos.sites"
+# injected delay for the `task.slow` straggler site (ISSUE 11): a task
+# whose (stage, partition, attempt) coordinate draws a slow verdict sleeps
+# this long before executing — deterministic stragglers for the
+# p99-under-chaos bench metric. The duplicate attempt is keyed on a
+# DIFFERENT attempt number, so it draws a fresh verdict.
+BALLISTA_CHAOS_SLOW_MS = "ballista.chaos.slow_ms"
 
 DEFAULT_SETTINGS: Dict[str, str] = {
     # 32768 is the reference's hard-coded default batch size
@@ -235,6 +267,15 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_CHAOS_SEED: "0",
     BALLISTA_CHAOS_RATE: "0",
     BALLISTA_CHAOS_SITES: "",
+    BALLISTA_CHAOS_SLOW_MS: "1000",
+    BALLISTA_TENANT_SLO_MS: "",
+    # speculation defaults ON: the 500ms floor + 4x slack mean fault-free
+    # runs (tasks well under the floor, or within slack of prediction)
+    # never launch a duplicate — only genuine stragglers do
+    BALLISTA_SPECULATION: "true",
+    BALLISTA_SPECULATION_MULTIPLIER: "4",
+    BALLISTA_SPECULATION_MIN_RUNTIME_MS: "500",
+    BALLISTA_PUSH_STATUS: "true",
 }
 
 
@@ -368,6 +409,44 @@ class BallistaConfig(Mapping[str, str]):
             out[name.strip()] = max(1, int(w))
         return out
 
+    def tenant_slos(self) -> Dict[str, float]:
+        """Per-tenant latency SLO deadlines in ms parsed from
+        "alice:250,bob:2000"; absent -> no SLO for that tenant."""
+        out: Dict[str, float] = {}
+        for part in self._settings[BALLISTA_TENANT_SLO_MS].split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, ms = part.rpartition(":")
+            if not name:
+                raise ValueError(
+                    f"bad {BALLISTA_TENANT_SLO_MS} entry {part!r} "
+                    "(expected tenant:milliseconds)"
+                )
+            out[name.strip()] = max(1.0, float(ms))
+        return out
+
+    def speculation(self) -> bool:
+        """Speculative duplicate attempts for cost-model-flagged stragglers
+        (ISSUE 11)."""
+        return self._settings[BALLISTA_SPECULATION].lower() in ("1", "true", "yes")
+
+    def speculation_multiplier(self) -> float:
+        """Slack factor over the predicted task cost before a RUNNING task
+        counts as a straggler."""
+        return max(1.0, float(self._settings[BALLISTA_SPECULATION_MULTIPLIER]))
+
+    def speculation_min_runtime_s(self) -> float:
+        """Minimum elapsed seconds before any task may speculate — cheap
+        tasks never do."""
+        return max(
+            0.0, float(self._settings[BALLISTA_SPECULATION_MIN_RUNTIME_MS])
+        ) / 1000.0
+
+    def push_status(self) -> bool:
+        """Client-side server-push job-status notifications (ISSUE 11)."""
+        return self._settings[BALLISTA_PUSH_STATUS].lower() in ("1", "true", "yes")
+
     def result_cache(self) -> bool:
         return self._settings[BALLISTA_RESULT_CACHE].lower() in ("1", "true", "yes")
 
@@ -434,6 +513,10 @@ class BallistaConfig(Mapping[str, str]):
         if not 0.0 <= r <= 1.0:
             raise ValueError(f"ballista.chaos.rate must be in [0, 1], got {r}")
         return r
+
+    def chaos_slow_ms(self) -> float:
+        """Injected straggler delay for the task.slow chaos site."""
+        return max(0.0, float(self._settings[BALLISTA_CHAOS_SLOW_MS]))
 
     def chaos_sites(self):
         """Enabled injection sites; [] = all registered sites."""

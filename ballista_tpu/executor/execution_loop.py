@@ -375,8 +375,11 @@ class PollLoop:
         status = pb.TaskStatus()
         status.partition_id.CopyFrom(pid)
         # echo the attempt in every reported status: the scheduler uses it
-        # to drop stale reports from attempts it already reset
+        # to drop stale reports from attempts it already reset — and the
+        # speculative provenance (ISSUE 11), so a losing duplicate's drop
+        # is attributable in the scheduler's logs/counters
         status.attempt = task.attempt
+        status.speculative = task.speculative
         try:
             # allowlist comes from the EXECUTOR's own config; the per-job
             # settings merged below are client-controlled and must not
@@ -411,6 +414,26 @@ class PollLoop:
                     "task.execute",
                     f"{pid.stage_id}/{pid.partition_id}@a{task.attempt}",
                 )
+                if chaos.should_inject(
+                    "task.slow",
+                    f"{pid.stage_id}/{pid.partition_id}@a{task.attempt}",
+                ):
+                    # deterministic straggler (ISSUE 11): the task still
+                    # completes correctly, just late — the seeded tail the
+                    # speculation subsystem must beat. Keyed on the attempt,
+                    # so a speculative duplicate (attempt N+1) draws a
+                    # FRESH verdict and is not slowed with its primary.
+                    from ballista_tpu.ops.runtime import record_recovery
+
+                    delay = cfg.chaos_slow_ms() / 1000.0
+                    record_recovery("chaos_injected")
+                    record_recovery("chaos_slow_injected")
+                    log.warning(
+                        "chaos[task.slow]: delaying task %s/%s/%s attempt "
+                        "%d by %.0fms", pid.job_id, pid.stage_id,
+                        pid.partition_id, task.attempt, delay * 1000,
+                    )
+                    time.sleep(delay)
             import functools
 
             ctx = TaskContext(

@@ -14,9 +14,15 @@ cache (ballista.tpu.cost_model_dir, default .ballista_cache/costmodel):
 
 ops in use: "join.gather" (units = padded gather elements), "join.host"
 (units = build+probe rows), "h2d" / "readback" (units = bytes),
-"compile|<step>" and "stage.run|<stage id>" (units = 1; stage id is the
-sha1 of the AOT stable stage key, so the store is keyed like the AOT cache
-on stable stage identity). Entries carry the jax/jaxlib/backend
+"compile|<step>" (units = 1), "stage.run|<stage id>" (units = the stage's
+input size in leaf-file bytes or memory-scan rows, ISSUE 11 — normalized
+so a rate learned at one scale predicts another; stage id is the sha1 of
+the AOT stable stage key, so the store is keyed like the AOT cache on
+stable stage identity), and "task.run|<shape>" under engine "task" (units
+= 1; the SCHEDULER's per-stage task durations, keyed on the
+job-id-scrubbed stage plan shape via task_run_op below — the rates behind
+speculative-execution straggler detection). Entries carry the
+jax/jaxlib/backend
 fingerprint of the writer (ops/aotcache.py::fingerprint): a store written
 by a different stack is ignored wholesale — costs measured on another
 backend must never steer this one.
@@ -51,8 +57,11 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, Optional, Tuple
 
-# bump to orphan every persisted entry (they are re-measured, not migrated)
-_FORMAT = 1
+# bump to orphan every persisted entry (they are re-measured, not migrated).
+# 2: stage.run units changed from 1 to input bytes/rows (ISSUE 11) — a
+# pre-existing store's unit-less rates would predict file_bytes x
+# seconds-per-run, a guaranteed gross mispredict per cached stage shape.
+_FORMAT = 2
 _STORE_BASENAME = "costs.json"
 
 # minimum observations before a rate is trusted for prediction
@@ -156,6 +165,17 @@ def _bucket(units: float) -> int:
 
 def _key(op: str, engine: str, bucket: int) -> str:
     return f"{op}|{engine}|b{bucket}"
+
+
+def task_run_op(shape: str) -> str:
+    """Cost-store op for scheduler-side task durations of one stage shape
+    (ISSUE 11). `shape` must already be job-independent (the caller scrubs
+    the job id from the plan display) so repeated queries of the same shape
+    share one rate across jobs — which is what lets the straggler monitor
+    predict a fresh job's task cost from history."""
+    import hashlib
+
+    return "task.run|" + hashlib.sha1(shape.encode()).hexdigest()[:12]
 
 
 # holds-lock: _lock

@@ -224,6 +224,12 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
     parts = []
     mtimes = []
     pinned = []
+    # input-size units for the stage.run cost observation (ISSUE 11
+    # satellite): leaf-file bytes (or memory-scan rows). units=1 made the
+    # whole-stage rate scale-blind — the first run after a file grew in
+    # place predicted the OLD size's seconds and counted one guaranteed
+    # gross mispredict; a per-byte rate predicts correctly at any scale.
+    unit_size = 0.0
     # persisted-layout eligibility: every leaf's data identity must be a
     # file set with covering mtimes. A shuffle-reader-fed (or otherwise
     # non-file) leaf contributes nothing to the mtime component, so the key
@@ -234,6 +240,11 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         if isinstance(leaf, MemoryScanExec):
             parts.append(str(id(leaf.source)))
             pinned.append(leaf.source)
+            unit_size += float(sum(
+                b.num_rows
+                for part in getattr(leaf.source, "partitions", ())
+                for b in part
+            ))
         elif hasattr(leaf, "source") and hasattr(leaf.source, "files"):
             # file mtimes invalidate the cached stage (and its
             # device-resident columns) when a file is rewritten; they live
@@ -243,6 +254,10 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
             for f in leaf.source.files:
                 if os.path.exists(f):
                     mtimes.append(str(os.path.getmtime(f)))
+                    try:
+                        unit_size += float(os.path.getsize(f))
+                    except OSError:
+                        pass
                 else:
                     mtimes.append("0")
                     file_backed = False  # mtime does not cover this leaf
@@ -361,11 +376,14 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
         # the run cost is a cost-store observation keyed on stable stage
         # identity (like the AOT cache), and the success is a recorded
         # routing decision — predicted from the stage's own history, so the
-        # bench mispredict rate covers the aggregate path too
+        # bench mispredict rate covers the aggregate path too. Units are
+        # the stage's input size (file bytes / memory rows), so the learned
+        # rate scales with the data instead of memorizing one run's seconds
+        # (ISSUE 11 satellite — units=1 mispredicted once per data growth).
         import hashlib
 
         op = "stage.run|" + hashlib.sha1(stable.encode()).hexdigest()[:12]
-        with costmodel.timed(op, routing_op="stage"):
+        with costmodel.timed(op, units=max(1.0, unit_size), routing_op="stage"):
             out = stage.run(partition, ctx)
         return out
     except UnsupportedOnDevice:

@@ -841,6 +841,35 @@ def serving_stats(reset: bool = False) -> Dict[str, int]:
     return out
 
 
+# accumulated speculative-execution events (ISSUE 11): duplicate-attempt
+# launches and their outcomes ("launched" / "won" = the duplicate finished
+# first / "lost" = the primary beat it / "failed" = the duplicate itself
+# died / "promoted" = the primary died and the in-flight duplicate became
+# the current attempt / "orphaned" / "executor_lost"), the duplicated
+# compute discarded when a pair resolves ("wasted_seconds", a float), and
+# per-tenant SLO outcomes ("slo_misses" / "slo_met" — jobs completing past
+# or within their ballista.tenant.slo_ms deadline). Same in-process
+# accumulator pattern as recovery/tenancy/serving above; bench.py reports a
+# per-config `speculation` block off this beside `recovery`/`routing`.
+_speculation_lock = threading.Lock()
+_speculation: Dict[str, float] = {}  # event -> count/seconds; guarded-by: _speculation_lock
+
+
+def record_speculation(event: str, n: float = 1) -> None:
+    with _speculation_lock:
+        _speculation[event] = _speculation.get(event, 0) + n
+
+
+def speculation_stats(reset: bool = False) -> Dict[str, float]:
+    """Snapshot of accumulated speculation counters (wasted_seconds is a
+    float total; everything else is an integral count)."""
+    with _speculation_lock:
+        out = dict(_speculation)
+        if reset:
+            _speculation.clear()
+    return out
+
+
 # accumulated adaptive-routing decisions (ISSUE 10): every engine choice
 # the cost-model-aware ladder makes — device / host / split — lands here
 # with its predicted-vs-observed cost when a prediction existed, plus named

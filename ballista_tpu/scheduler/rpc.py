@@ -49,10 +49,11 @@ _METHODS = {
     ),
 }
 
-# server-streaming methods (ISSUE 8): the response type streams. Kept in a
-# separate table because the handler/stub constructors differ.
+# server-streaming methods (ISSUE 8/11): the response type streams. Kept in
+# a separate table because the handler/stub constructors differ.
 _STREAM_METHODS = {
     "SubscribeWork": (pb.SubscribeWorkParams, pb.TaskDefinition),
+    "SubscribeJobStatus": (pb.GetJobStatusParams, pb.GetJobStatusResult),
 }
 
 
@@ -174,9 +175,16 @@ class SchedulerGrpcClient:
                 code = e.code() if hasattr(e, "code") else None
                 detail = e.details() if hasattr(e, "details") else str(e)
                 # UNAVAILABLE covers both "server not up yet" (connect
-                # refused) and "went away mid-call"; anything else is the
-                # server actually answering — surface it immediately
-                transient = code == grpc.StatusCode.UNAVAILABLE or (
+                # refused) and "went away mid-call". CANCELLED is the other
+                # went-away shape (ISSUE 11): a scheduler crash/restart
+                # stops its gRPC server, which GOAWAYs in-flight unary
+                # calls as CANCELLED — for a crash-tolerant client that is
+                # the same transient as UNAVAILABLE (this client never
+                # cancels its own unary calls). Anything else is the
+                # server actually answering — surface it immediately.
+                transient = code in (
+                    grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.CANCELLED
+                ) or (
                     also_transient is not None and also_transient(detail)
                 )
                 err = e
@@ -202,6 +210,13 @@ class SchedulerGrpcClient:
 
     def get_job_status(self, params: pb.GetJobStatusParams) -> pb.GetJobStatusResult:
         return self._call("GetJobStatus", params)
+
+    def subscribe_job_status(self, params: pb.GetJobStatusParams):
+        """Open the push job-status stream (ISSUE 11). Returns the live
+        gRPC call object — an iterator of GetJobStatusResult that also
+        supports .cancel(). NO retry wrapper, like subscribe_work: the
+        client's status-watch helper owns fallback-to-polling on any drop."""
+        return self._stream_stubs["SubscribeJobStatus"](params)
 
     def get_executors_metadata(self) -> pb.GetExecutorMetadataResult:
         return self._call("GetExecutorsMetadata", pb.GetExecutorMetadataParams())

@@ -131,6 +131,20 @@ class SchedulerServer:
         self._push_mu = threading.Lock()
         self._subscribers: Dict[str, _PushSubscriber] = {}  # guarded-by: self._push_mu
         self._push_seq = 0  # scheduler.push chaos rotation; under the kv lock
+        # push job-status notifications (ISSUE 11): job id -> queues of
+        # open SubscribeJobStatus streams. The state hook fans every
+        # job-status write out to them; each stream terminates itself after
+        # a terminal status (or client disconnect), so entries are
+        # short-lived. Queue puts are internally thread-safe; the dict is
+        # guarded by its own lock (never taken with the KV lock held by
+        # anything that blocks).
+        self._status_mu = threading.Lock()
+        self._status_subs: Dict[str, list] = {}  # guarded-by: self._status_mu
+        # job -> last pushed serialized status: synchronize_job_status
+        # re-writes a byte-identical running status on every non-final
+        # task completion; one push per TRANSITION means suppressing those
+        self._status_last: Dict[str, bytes] = {}  # guarded-by: self._status_mu
+        self.state.on_job_status = self._notify_job_status
 
     # -- crash simulation ---------------------------------------------------
     def _refuse_if_crashed(self, context) -> None:
@@ -249,6 +263,11 @@ class SchedulerServer:
                     # link job -> entry so a lost cached result partition
                     # (ReportLostPartition) invalidates the right entry
                     self.state.save_job_fingerprint(job_id, fp[1])
+                    # cache-served jobs complete HERE, never through
+                    # synchronize_job_status — their SLO outcome (ISSUE
+                    # 11) counts all the same, or per-tenant attainment
+                    # would exclude exactly the fastest workloads
+                    self.state._note_job_slo(job_id)
                     log.info(
                         "job %s served from result cache (tenant=%s, fp=%s...)",
                         job_id, tenant or "<default>", fp[1][:16],
@@ -429,14 +448,96 @@ class SchedulerServer:
                 del self._subscribers[sub.executor_id]
 
     def close_push_streams(self) -> None:
-        """Close every subscriber stream NOW (shutdown/restart/crash): the
+        """Close every server-push stream NOW (shutdown/restart/crash) —
+        work-dispatch subscribers AND job-status subscribers: the
         generators return on their sentinel instead of finishing a 0.25s
-        tick, so the gRPC server's stop().wait() drains promptly."""
+        tick, so the gRPC server's stop().wait() drains promptly (clients
+        fall back to status polling until they re-subscribe)."""
         with self._push_mu:
             subs = list(self._subscribers.values())
             self._subscribers.clear()
         for sub in subs:
             sub.close()
+        with self._status_mu:
+            status_qs = [q for qs in self._status_subs.values() for q in qs]
+            self._status_subs.clear()
+            self._status_last.clear()
+        for q in status_qs:
+            q.put(None)
+
+    # -- push job-status notifications (ISSUE 11) ---------------------------
+    def _notify_job_status(self, job_id: str, status: pb.JobStatus) -> None:
+        """State hook: fan one job-status write out to this job's open
+        SubscribeJobStatus streams — one push per TRANSITION: a re-write
+        byte-identical to the last pushed status is suppressed. Each
+        subscriber gets its own copy (the caller may keep mutating the
+        message)."""
+        with self._status_mu:
+            qs = list(self._status_subs.get(job_id, ()))
+            if not qs:
+                # no listeners: skip the serialization too — this hook
+                # rides the scheduler's hottest write path
+                self._status_last.pop(job_id, None)
+                return
+            data = status.SerializeToString()
+            if self._status_last.get(job_id) == data:
+                return
+            self._status_last[job_id] = data
+        for q in qs:
+            snap = pb.JobStatus()
+            snap.CopyFrom(status)
+            q.put(snap)
+
+    def SubscribeJobStatus(self, request: pb.GetJobStatusParams, context=None):
+        """Server-streaming job-status push (ISSUE 11): one
+        GetJobStatusResult per status transition, seeded with the current
+        status (subscribing after completion still answers immediately),
+        terminating after a terminal status. Mirrors SubscribeWork's
+        lifecycle: the client's status POLL stays as the automatic fallback
+        whenever this stream is down, refused, or racing a restart."""
+        self._refuse_if_crashed(context)
+        job_id = request.job_id
+        q: "queue.Queue" = queue.Queue()
+        with self._status_mu:
+            self._status_subs.setdefault(job_id, []).append(q)
+        cur = self.state.get_job_metadata(job_id)
+        if cur is not None:
+            # the seed is this subscriber's baseline — record it for the
+            # transition dedup too (only when no push set it already: a
+            # racing notify may have just advanced it past this snapshot)
+            with self._status_mu:
+                self._status_last.setdefault(job_id, cur.SerializeToString())
+            q.put(cur)
+
+        def stream():
+            try:
+                while not self.crashed:
+                    if context is not None and not context.is_active():
+                        return
+                    try:
+                        st = q.get(timeout=0.25)
+                    except queue.Empty:
+                        continue
+                    if st is None:  # close sentinel (shutdown/restart)
+                        return
+                    res = pb.GetJobStatusResult()
+                    res.status.CopyFrom(st)
+                    yield res
+                    if st.WhichOneof("status") in ("completed", "failed"):
+                        return
+            finally:
+                with self._status_mu:
+                    qs = self._status_subs.get(job_id)
+                    if qs is not None:
+                        try:
+                            qs.remove(q)
+                        except ValueError:
+                            pass
+                        if not qs:
+                            del self._status_subs[job_id]
+                            self._status_last.pop(job_id, None)
+
+        return stream()
 
     def _pump_pushes(self) -> int:
         """Assign + push runnable tasks to every subscribed executor with
@@ -478,7 +579,13 @@ class SchedulerServer:
         # behind our back (orphan reconciliation, lost-task reset) must
         # free its credit even though no terminal status ever arrives.
         # Bounded by `slots` reads, and only when credit is actually held.
+        # A SPECULATIVE duplicate (ISSUE 11) has no tasks/ status of its
+        # own — its credit stands while its speculation-ledger entry lives.
         for key in list(sub.outstanding):
+            if self.state.speculation_active(
+                (key[0], key[1], key[2]), sub.executor_id, key[3]
+            ):
+                continue
             cur = self.state.get_task_status(key[0], key[1], key[2])
             if (
                 cur is None
@@ -489,6 +596,7 @@ class SchedulerServer:
                 sub.outstanding.discard(key)
         pushed = 0
         while len(sub.outstanding) < sub.slots and not sub.closed.is_set():
+            speculative = False
             try:
                 assigned = self.state.assign_next_schedulable_task(
                     sub.executor_id
@@ -499,6 +607,12 @@ class SchedulerServer:
                 # with a rotated admission key — same recovery story as
                 # the aborted-PollWork form of this site
                 break
+            if assigned is None:
+                # no fresh work for this executor: offer the slot to the
+                # straggler monitor — push dispatch is exactly what makes
+                # a speculative duplicate land instantly (ISSUE 11)
+                assigned = self.state.maybe_speculate(sub.executor_id)
+                speculative = assigned is not None
             if assigned is None:
                 break
             status, plan = assigned
@@ -519,6 +633,7 @@ class SchedulerServer:
                 self._close_subscriber(sub)
                 break
             td = self._task_definition(status, plan)
+            td.speculative = speculative
             sub.outstanding.add(
                 (pid.job_id, pid.stage_id, pid.partition_id, status.attempt)
             )
@@ -644,12 +759,20 @@ class SchedulerServer:
                         )
             result = pb.PollWorkResult()
             if request.can_accept_task:
+                speculative = False
                 assigned = self.state.assign_next_schedulable_task(request.metadata.id)
+                if assigned is None:
+                    # idle capacity + no fresh work: offer the slot to the
+                    # straggler monitor (ISSUE 11) — on poll-mode clusters
+                    # this is how a speculative duplicate dispatches
+                    assigned = self.state.maybe_speculate(request.metadata.id)
+                    speculative = assigned is not None
                 if assigned is not None:
                     from ballista_tpu.ops.runtime import record_serving
 
                     status, plan = assigned
                     result.task.CopyFrom(self._task_definition(status, plan))
+                    result.task.speculative = speculative
                     record_serving("dispatch_poll")
             for job_id in jobs:
                 self.state.synchronize_job_status(job_id)

@@ -67,6 +67,12 @@ def _record_tenancy(event: str, n: int = 1) -> None:
     record_tenancy(event, n)
 
 
+def _record_speculation(event: str, n: float = 1) -> None:
+    from ballista_tpu.ops.runtime import record_speculation
+
+    record_speculation(event, n)
+
+
 def _attempts_error(t: pb.TaskStatus) -> str:
     """Human-readable failure naming EVERY attempt of the task — the error
     a job fails with once retries are exhausted."""
@@ -227,6 +233,9 @@ class JobPlanBatch:
         if self._state._task_index is not None:
             for t in self._tasks:
                 self._state._task_index.observe(t)
+        # the running flip bypasses save_job_metadata (it rides the atomic
+        # batch), so push-status subscribers (ISSUE 11) are notified here
+        self._state._notify_job_status(self.job_id, running)
 
 
 class SchedulerState:
@@ -276,7 +285,8 @@ class SchedulerState:
         # Both are touched from PollWork (under the global KV lock) AND from
         # ExecuteQuery / test probes, so they carry their own lock.
         self._tenant_mu = threading.Lock()
-        self._tenant_cache: Dict[str, Tuple[str, int]] = {}  # guarded-by: self._tenant_mu
+        # job -> (tenant, priority, created_at); guarded-by: self._tenant_mu
+        self._tenant_cache: Dict[str, Tuple[str, int, float]] = {}
         self.tenant_assigned: Dict[str, int] = {}  # guarded-by: self._tenant_mu
         # scheduler.admit chaos rotation: like _chaos_puts, a per-process
         # admission sequence so a faulted admission's retry (the executor's
@@ -287,6 +297,58 @@ class SchedulerState:
         # not raise inside every assignment scan and wedge all scheduling
         self._tenant_weights = self.config.tenant_weights()
         self._tenant_quota = self.config.tenant_max_inflight()
+        self._tenant_slos = self.config.tenant_slos()
+        # -- speculative execution (ISSUE 11) ------------------------------
+        # the scheduler is also a cost-model CLIENT now: completed task
+        # durations are observed under job-independent task.run ops and the
+        # straggler monitor predicts from them, so configure the store from
+        # this config (idempotent beside the executor-side configures — a
+        # standalone cluster shares one process-global store)
+        from ballista_tpu.ops import costmodel
+
+        costmodel.configure(self.config)
+        self._spec_enabled = self.config.speculation()
+        self._spec_multiplier = self.config.speculation_multiplier()
+        self._spec_floor_s = self.config.speculation_min_runtime_s()
+        # running-task watch: (job, stage, part) -> (executor, attempt,
+        # monotonic start). Maintained by save_task_status (the single task
+        # write path), consumed by the straggler monitor and by the
+        # completion-duration observation. In-memory only — a restarted
+        # scheduler re-learns durations from fresh completions.
+        self._running_since: Dict[
+            Tuple[str, int, int], Tuple[str, int, float]
+        ] = {}
+        # active speculative duplicates: (job, stage, part) -> (executor,
+        # attempt, monotonic launch, vouched, restored). Write-through to
+        # speculation/{job}/{stage}/{part} (pb.Assignment) so a scheduler
+        # restart recovers BOTH attempts of an in-flight pair — the primary
+        # from its tasks/ running status, the duplicate from here.
+        self._speculative: Dict[
+            Tuple[str, int, int], Tuple[str, int, float, bool, bool]
+        ] = {}
+        # per-(job, stage) cache of the job-independent task.run cost op
+        self._task_op_cache: Dict[Tuple[str, int], str] = {}
+        # scheduler-owned task.run rates (op -> (total seconds, n)): the
+        # process-global cost store is cleared by ANY job whose merged
+        # per-job settings carry a different cost_model_dir (configure()
+        # drops the store on a dir change) — the straggler monitor must
+        # not lose its rates to a client config quirk. Observations mirror
+        # into the store too (observability + cross-restart persistence
+        # when a dir is configured); predictions consult this first.
+        self._task_rates: Dict[str, Tuple[float, int]] = {}
+        # tenant -> last wall time its oldest pending job was seen overdue:
+        # the admit_slo_boosted counter counts boost EPISODES (enter
+        # overdue), not admission scans — the scan runs on every poll/pump
+        # tick, and a momentary pending-set drain at a stage boundary must
+        # not end (and re-count) a continuous episode
+        self._slo_boosted: Dict[str, float] = {}
+        # jobs whose SLO outcome was already counted: restart_completed_job
+        # can re-fold a job to completed; one job is one outcome
+        self._slo_noted: set = set()
+        # push job-status notifications (ISSUE 11): the server installs a
+        # callback invoked on every job-status write; must never raise into
+        # the write path
+        self.on_job_status = None
         # best-effort live result-cache entry count (ISSUE 8): lets the
         # under-cap common case of result_cache_put skip the full prefix
         # scan (a 1024-key range read per job completion, under the global
@@ -318,6 +380,46 @@ class SchedulerState:
         self._assigned.pop(key, None)
         self.kv.delete(self._ledger_key(key))
 
+    # -- speculative-attempt ledger (ISSUE 11) ------------------------------
+    def _spec_key(self, key: Tuple[str, int, int]) -> str:
+        job_id, stage_id, partition = key
+        return self._key("speculation", job_id, str(stage_id), str(partition))
+
+    def _spec_put(
+        self, key: Tuple[str, int, int], executor_id: str, attempt: int
+    ) -> None:
+        """Record an in-flight speculative duplicate, write-through like the
+        assignment ledger: the KV carries the restart truth, memory the
+        grace/accounting clocks."""
+        self._speculative[key] = (
+            executor_id, attempt, time.monotonic(), False, False,
+        )
+        msg = pb.Assignment(executor_id=executor_id, attempt=attempt)
+        self.kv.put(self._spec_key(key), msg.SerializeToString())
+
+    def _spec_del(self, key: Tuple[str, int, int]) -> None:
+        if self._speculative.pop(key, None) is not None:
+            self.kv.delete(self._spec_key(key))
+
+    def speculation_active(
+        self, key: Tuple[str, int, int], executor_id: str, attempt: int
+    ) -> bool:
+        """True while (executor, attempt) is the live speculative duplicate
+        of the task — the push-credit re-verification consults this (the
+        duplicate has no tasks/ status of its own to vouch for it)."""
+        s = self._speculative.get(key)
+        return s is not None and s[0] == executor_id and s[1] == attempt
+
+    def _notify_job_status(self, job_id: str, status: pb.JobStatus) -> None:
+        """Invoke the push-status hook (ISSUE 11); a subscriber bug must
+        never fail the status write it observes."""
+        cb = self.on_job_status
+        if cb is not None:
+            try:
+                cb(job_id, status)
+            except Exception:
+                log.debug("job-status notification failed", exc_info=True)
+
     def recover(self) -> Dict[str, int]:
         """Scheduler-restart recovery: called once before serving (the
         caller holds no lock yet — nothing else can touch this state).
@@ -343,7 +445,8 @@ class SchedulerState:
         {} without recording anything."""
         jobs = list(self.kv.get_prefix(self._key("jobs")))
         ledger = list(self.kv.get_prefix(self._key("assignments")))
-        if not jobs and not ledger:
+        spec_ledger = list(self.kv.get_prefix(self._key("speculation")))
+        if not jobs and not ledger and not spec_ledger:
             return {}
         stats: Dict[str, int] = {}
 
@@ -395,6 +498,27 @@ class SchedulerState:
                 continue
             self._assigned[key] = (a.executor_id, a.attempt, now, True)
             bump("restart_assignment_restored")
+        for k, v in spec_ledger:
+            # speculative duplicates (ISSUE 11): valid while the primary is
+            # still RUNNING at exactly attempt-1 — the pair's completions
+            # then resolve through the normal first-completion-wins path.
+            # Anything else (primary resolved, requeued, or the pair
+            # already settled) is a leftover record to sweep.
+            tail = k.rsplit("/", 3)
+            key = (tail[1], int(tail[2]), int(tail[3]))
+            a = pb.Assignment()
+            a.ParseFromString(v)
+            cur = self.get_task_status(*key)
+            if (
+                cur is None
+                or cur.WhichOneof("status") != "running"
+                or cur.attempt != a.attempt - 1
+            ):
+                self.kv.delete(k)
+                continue
+            self._speculative[key] = (a.executor_id, a.attempt, now, False, True)
+            _record_speculation("restored")
+            bump("restart_speculation_restored")
         if stats:
             log.warning("scheduler restart recovery: %s", stats)
         return stats
@@ -426,6 +550,7 @@ class SchedulerState:
     # -- jobs -----------------------------------------------------------------
     def save_job_metadata(self, job_id: str, status: pb.JobStatus) -> None:
         self.kv.put(self._key("jobs", job_id), status.SerializeToString())
+        self._notify_job_status(job_id, status)
 
     def get_job_metadata(self, job_id: str) -> Optional[pb.JobStatus]:
         v = self.kv.get(self._key("jobs", job_id))
@@ -452,17 +577,22 @@ class SchedulerState:
         return {kv.key: kv.value for kv in msg.settings}
 
     # -- tenancy (ISSUE 7) ----------------------------------------------------
-    def save_job_tenant(self, job_id: str, tenant: str, priority: int) -> None:
+    def save_job_tenant(
+        self, job_id: str, tenant: str, priority: int,
+        created_at: Optional[float] = None,
+    ) -> None:
         """Durable per-job tenant record: admission quotas, fair-share
-        accounting, and priority ordering survive a scheduler restart."""
-        msg = pb.JobTenant(tenant=tenant, priority=priority)
+        accounting, priority ordering, and the SLO-deadline anchor
+        (created_at, ISSUE 11) survive a scheduler restart."""
+        created = time.time() if created_at is None else created_at
+        msg = pb.JobTenant(tenant=tenant, priority=priority, created_at=created)
         self.kv.put(self._key("tenants", job_id), msg.SerializeToString())
         with self._tenant_mu:
-            self._tenant_cache[job_id] = (tenant, priority)
+            self._tenant_cache[job_id] = (tenant, priority, created)
 
-    def job_tenant(self, job_id: str) -> Tuple[str, int]:
-        """(tenant, priority) of a job; ("", 0) for pre-tenancy jobs.
-        Read-through cached — the record is immutable per job."""
+    def _job_tenant_full(self, job_id: str) -> Tuple[str, int, float]:
+        """(tenant, priority, created_at) of a job; ("", 0, 0.0) for
+        pre-tenancy jobs. Read-through cached — the record is immutable."""
         with self._tenant_mu:
             hit = self._tenant_cache.get(job_id)
             if hit is not None:
@@ -472,14 +602,23 @@ class SchedulerState:
                 # accumulate every job id it ever saw
                 self._tenant_cache.clear()
         v = self.kv.get(self._key("tenants", job_id))
-        out = ("", 0)
+        out = ("", 0, 0.0)
         if v is not None:
             msg = pb.JobTenant()
             msg.ParseFromString(v)
-            out = (msg.tenant, msg.priority)
+            out = (msg.tenant, msg.priority, msg.created_at)
         with self._tenant_mu:
             self._tenant_cache[job_id] = out
         return out
+
+    def job_tenant(self, job_id: str) -> Tuple[str, int]:
+        """(tenant, priority) of a job; ("", 0) for pre-tenancy jobs."""
+        return self._job_tenant_full(job_id)[:2]
+
+    def job_created_at(self, job_id: str) -> float:
+        """Submission time (unix seconds; 0.0 when unknown) — the anchor
+        for the per-tenant SLO deadline (ISSUE 11)."""
+        return self._job_tenant_full(job_id)[2]
 
     def note_tenant_assigned(self, tenant: str) -> None:
         with self._tenant_mu:
@@ -663,6 +802,22 @@ class SchedulerState:
     def save_task_status(self, status: pb.TaskStatus) -> None:
         pid = status.partition_id
         key = self._key("tasks", pid.job_id, str(pid.stage_id), str(pid.partition_id))
+        # maintain the running-task watch (ISSUE 11): the straggler monitor
+        # compares each entry's elapsed time against its cost prediction,
+        # and completions observe their duration into the cost store
+        key3 = (pid.job_id, pid.stage_id, pid.partition_id)
+        if status.WhichOneof("status") == "running":
+            cur = self._running_since.get(key3)
+            if (
+                cur is None
+                or cur[0] != status.running.executor_id
+                or cur[1] != status.attempt
+            ):
+                self._running_since[key3] = (
+                    status.running.executor_id, status.attempt, time.monotonic(),
+                )
+        else:
+            self._running_since.pop(key3, None)
         self.kv.put(key, status.SerializeToString())
         if self._task_index is not None:
             self._task_index.observe(status)
@@ -683,7 +838,35 @@ class SchedulerState:
             self._chaos_puts += 1
             self._chaos.maybe_fail("kv.put", f"put{self._chaos_puts}")
         pid = status.partition_id
+        key3 = (pid.job_id, pid.stage_id, pid.partition_id)
         current = self.get_task_status(pid.job_id, pid.stage_id, pid.partition_id)
+        w = status.WhichOneof("status")
+        spec = self._speculative.get(key3)
+        if current is not None and current.WhichOneof("status") == "completed":
+            # first completion wins (ISSUE 11): once any attempt's result
+            # stands, every DIFFERENT later report — a speculation pair's
+            # losing sibling included — is stale, whatever its attempt
+            # number (the duplicate runs attempt N+1, so the numeric guard
+            # below alone would let it clobber the primary's published
+            # locations). A redelivery of the SAME completion (same
+            # attempt, same executor) stays accepted: PollWork requeues
+            # undelivered statuses after a crash, and the accept must stay
+            # idempotent or the redelivery never re-enters the job-sync
+            # set and the job wedges in running.
+            if not (
+                w == "completed"
+                and status.attempt == current.attempt
+                and status.completed.executor_id == current.completed.executor_id
+            ):
+                _record_recovery("stale_status_dropped")
+                log.info(
+                    "dropping late status for resolved task %s/%s/%s "
+                    "(attempt %d%s; completion already stands)",
+                    pid.job_id, pid.stage_id, pid.partition_id,
+                    status.attempt,
+                    " speculative" if status.speculative else "",
+                )
+                return False
         if current is not None and status.attempt < current.attempt:
             _record_recovery("stale_status_dropped")
             log.info(
@@ -692,6 +875,62 @@ class SchedulerState:
                 status.attempt, current.attempt,
             )
             return False
+        if spec is not None:
+            spec_exec, spec_attempt, spec_t0, _v, _r = spec
+            if status.attempt == spec_attempt and w in ("failed", "fetch_failed"):
+                # the DUPLICATE itself died; the primary still runs — retire
+                # the speculation without touching the task (a failed
+                # duplicate never consumes the task's retry budget)
+                self._spec_del(key3)
+                _record_speculation("failed")
+                if w == "fetch_failed":
+                    # the report still carries actionable lineage: the named
+                    # map output is gone for EVERY future consumer. Recompute
+                    # it now instead of waiting for the next consumer (the
+                    # primary included) to trip on it a full failure
+                    # round-trip later. The reporter itself needs no requeue
+                    # — the primary still runs.
+                    self._recompute_lost_map(
+                        pid.job_id, status.fetch_failed,
+                        self.retry_limit(pid.job_id),
+                        f"speculative attempt on {spec_exec}",
+                    )
+                log.warning(
+                    "speculative attempt %d of %s/%s/%s failed on %s; "
+                    "primary continues", spec_attempt,
+                    pid.job_id, pid.stage_id, pid.partition_id, spec_exec,
+                )
+                return False
+            if w == "completed":
+                # a completion resolves the race NOW; the sibling's late
+                # report is dropped by the guards above
+                now = time.monotonic()
+                if status.attempt == spec_attempt:
+                    prim = self._running_since.get(key3)
+                    _record_speculation("won")
+                    _record_speculation(
+                        "wasted_seconds",
+                        now - (prim[2] if prim is not None else spec_t0),
+                    )
+                else:
+                    _record_speculation("lost")
+                    _record_speculation("wasted_seconds", now - spec_t0)
+                self._spec_del(key3)
+                log.info(
+                    "speculation resolved for %s/%s/%s: %s attempt %d won",
+                    pid.job_id, pid.stage_id, pid.partition_id,
+                    "speculative" if status.attempt == spec_attempt
+                    else "primary", status.attempt,
+                )
+        if w == "completed":
+            # observe the attempt's duration under the stage's
+            # job-independent task.run op — the rates the straggler monitor
+            # predicts from (sibling completions warm it within one job)
+            rs = self._running_since.get(key3)
+            if rs is not None and rs[1] == status.attempt:
+                self._observe_task_run(
+                    pid.job_id, pid.stage_id, time.monotonic() - rs[2]
+                )
         merged = pb.TaskStatus()
         merged.CopyFrom(status)
         if current is not None and current.history:
@@ -770,19 +1009,87 @@ class SchedulerState:
         return self.config.max_task_retries()
 
     def requeue_task(
-        self, t: pb.TaskStatus, executor_id: str, error: str, limit: int
+        self, t: pb.TaskStatus, executor_id: str, error: str, limit: int,
+        promote: bool = True,
     ) -> bool:
         """Put a failed/lost task back to pending for attempt N+1, recording
         attempt N (executor + error) in the history. Returns False without
         writing when the retry budget is exhausted — the caller fails the
-        job with the full history instead."""
-        if t.attempt >= limit:
-            return False
+        job with the full history instead.
+
+        Speculation-aware (ISSUE 11): when the PRIMARY attempt dies while
+        its speculative duplicate is still in flight, the duplicate IS the
+        retry — it is promoted to the task's current attempt (running, on
+        its executor, with the failure recorded in the history) instead of
+        requeueing fresh work. A promotion consumes no retry budget: the
+        duplicate was already dispatched and attempt numbering already
+        advanced when it launched. Callers requeueing because the task's
+        UPSTREAM locations went stale (lineage invalidation, fetch_failed)
+        pass promote=False — the duplicate was bound to the same dead
+        locations, so it is retired below instead of promoted into a
+        doomed attempt."""
         pid0 = t.partition_id
+        key3 = (pid0.job_id, pid0.stage_id, pid0.partition_id)
+        spec = self._speculative.get(key3)
+        if (
+            promote
+            and spec is not None
+            and spec[1] == t.attempt + 1
+            and spec[0] != executor_id
+            # same budget bound as a normal requeue: a task already AT its
+            # final allowed attempt must fail the job, not ride promotion
+            # to attempt numbers past the configured limit
+            and t.attempt < limit
+            and t.WhichOneof("status") in ("running", "failed", "fetch_failed")
+        ):
+            promoted = pb.TaskStatus()
+            promoted.partition_id.CopyFrom(t.partition_id)
+            promoted.attempt = spec[1]
+            promoted.speculative = True
+            promoted.history.MergeFrom(t.history)
+            h = promoted.history.add()
+            h.attempt = t.attempt
+            h.executor_id = executor_id
+            h.error = error
+            promoted.running.executor_id = spec[0]
+            self._ledger_del(key3)  # superseded primary assignment
+            self.save_task_status(promoted)
+            # the duplicate has been RUNNING since its launch, not since
+            # this promotion — keep the watch clock honest (save_task_
+            # status just re-stamped it with now) or its completion would
+            # observe an understated duration into the task.run rates and
+            # teach the monitor to over-speculate on this shape
+            self._running_since[key3] = (spec[0], spec[1], spec[2])
+            # the promoted attempt enters the normal assignment ledger:
+            # its owner's next echo vouches for it, and a restart re-adopts
+            # it like any in-flight assignment
+            self._ledger_put(key3, spec[0], spec[1])
+            self._spec_del(key3)
+            _record_speculation("promoted")
+            log.warning(
+                "promoted speculative attempt %d of %s/%s/%s on %s "
+                "(primary attempt %d lost: %s)",
+                promoted.attempt, pid0.job_id, pid0.stage_id,
+                pid0.partition_id, promoted.running.executor_id,
+                t.attempt, error,
+            )
+            return True
+        if t.attempt >= limit:
+            # exhausted: the job fails — retire any in-flight duplicate's
+            # record with it (its late report is dropped by the guards)
+            if spec is not None:
+                self._spec_del(key3)
+                _record_speculation("failed")
+            return False
         # any in-flight assignment of the superseded attempt is now stale;
         # clearing it here keeps the durable ledger from carrying entries a
-        # restarted scheduler would have to re-validate and discard
+        # restarted scheduler would have to re-validate and discard — a
+        # stale speculation record included (the requeued attempt would
+        # collide with the duplicate's attempt number)
         self._ledger_del((pid0.job_id, pid0.stage_id, pid0.partition_id))
+        if spec is not None:
+            self._spec_del(key3)
+            _record_speculation("failed")
         pending = pb.TaskStatus()
         pending.partition_id.CopyFrom(t.partition_id)
         pending.attempt = t.attempt + 1
@@ -907,7 +1214,11 @@ class SchedulerState:
                         f"{sorted(stages)} lost mid-run"
                     )
                     if not self.requeue_task(
-                        t, t.running.executor_id, error, limit_of(job_id)
+                        t, t.running.executor_id, error, limit_of(job_id),
+                        # the task's upstream bindings are what died — a
+                        # speculative duplicate carries the same dead
+                        # locations and must not be promoted into them
+                        promote=False,
                     ):
                         exhausted = pb.TaskStatus()
                         exhausted.CopyFrom(t)
@@ -918,6 +1229,15 @@ class SchedulerState:
                         break
                     _record_recovery("downstream_invalidated")
                     reset += 1
+        # prune watch entries of finished jobs (ISSUE 11): a job that
+        # failed with tasks still marked running would otherwise pin its
+        # entries (and any speculation records) forever
+        for key in list(self._running_since):
+            if job_finished(key[0]):
+                self._running_since.pop(key, None)
+        for key in list(self._speculative):
+            if job_finished(key[0]):
+                self._spec_del(key)
         return reset
 
     def handle_fetch_failed(self, t: pb.TaskStatus, limit: int) -> bool:
@@ -932,12 +1252,27 @@ class SchedulerState:
             f"fetch_failed: shuffle output {ff.map_executor_id}:{ff.path} "
             f"(map {ff.map_stage_id}/{ff.map_partition_id}) unreachable: {ff.error}"
         )
-        if not self.requeue_task(t, ff.executor_id, reporter_error, limit):
+        # promote=False: the reporter's duplicate (if any) was bound to the
+        # same lost shuffle location — retire it rather than promote it
+        # into a fetch that is known to fail
+        if not self.requeue_task(
+            t, ff.executor_id, reporter_error, limit, promote=False
+        ):
             return False
-        # recompute ONLY the named map partition — and only if its current
-        # completed output is the one reported lost (a concurrent reset or
-        # recompute may already have moved it)
-        mt = self.get_task_status(pid.job_id, ff.map_stage_id, ff.map_partition_id)
+        self._recompute_lost_map(pid.job_id, ff, limit, ff.executor_id)
+        return True
+
+    def _recompute_lost_map(self, job_id: str, ff, limit: int,
+                            reporter: str) -> None:
+        """Recompute ONLY the named lost map partition — and only if its
+        current completed output is the one reported lost (a concurrent
+        reset or recompute may already have moved it). Shared by the
+        primary fetch_failed path and the speculative-duplicate report
+        (ISSUE 11), so the recompute rule cannot silently diverge. When
+        the map partition is out of budget its data is gone for good: the
+        consumers' retries will exhaust and fail the job with the full
+        lineage in the error."""
+        mt = self.get_task_status(job_id, ff.map_stage_id, ff.map_partition_id)
         if (
             mt is not None
             and mt.WhichOneof("status") == "completed"
@@ -946,14 +1281,10 @@ class SchedulerState:
             if self.requeue_task(
                 mt,
                 ff.map_executor_id,
-                f"shuffle output lost (fetch_failed reported by {ff.executor_id})",
+                f"shuffle output lost (fetch_failed reported by {reporter})",
                 limit,
             ):
                 _record_recovery("map_recomputed")
-            # else: the map partition is out of budget; its data is gone for
-            # good, so the reporter's retries will exhaust and fail the job
-            # with the full lineage in the error
-        return True
 
     def restart_completed_job(self, job_id: str, executor_id: str) -> int:
         """Restart a job whose result partitions died with their executor
@@ -1017,6 +1348,214 @@ class SchedulerState:
         return restarted
 
     # -- scheduling ---------------------------------------------------------
+    def _bound_stage_plan(self, job_id: str, stage_id: int, idx: _TaskIndex):
+        """The stage plan with upstream shuffle locations bound, or None
+        while any upstream stage is incomplete (or the plan is missing).
+        Factored out of assign_next_schedulable_task so speculative
+        duplicates (ISSUE 11) bind EXACTLY like first attempts."""
+        plan = self.get_stage_plan(job_id, stage_id)
+        if plan is None:
+            return None
+        unresolved = find_unresolved_shuffles(plan)
+        locations: Dict[int, List[ShuffleLocation]] = {}
+        for u in unresolved:
+            # O(1) screen: stages the index knows are incomplete skip
+            # the KV read entirely (staleness toward "peer completed
+            # it" is bounded by the periodic reseed)
+            if not idx.stage_done(job_id, u.stage_id):
+                return None
+            # the locations are built from FRESH KV statuses with a
+            # final completeness check — a peer's lost-task reset
+            # (completed -> pending, unseen by this index) must block
+            # the stage, not hand out empty executor/path locations
+            upstream = self.get_stage_tasks(job_id, u.stage_id)
+            for t in upstream:
+                idx.observe(t)
+            if not upstream or any(
+                t.WhichOneof("status") != "completed" for t in upstream
+            ):
+                return None
+            locs = []
+            for t in sorted(upstream, key=lambda t: t.partition_id.partition_id):
+                meta = self.get_executor_metadata(t.completed.executor_id)
+                host, port = (meta.host, meta.port) if meta else ("", 0)
+                locs.append(
+                    ShuffleLocation(
+                        t.completed.executor_id,
+                        host,
+                        port,
+                        t.completed.path,
+                        stage_id=u.stage_id,
+                        map_partition=t.partition_id.partition_id,
+                    )
+                )
+            locations[u.stage_id] = locs
+        return remove_unresolved_shuffles(plan, locations) if unresolved else plan
+
+    # -- speculative execution (ISSUE 11) -----------------------------------
+    def _task_run_op(self, job_id: str, stage_id: int) -> str:
+        """Job-independent cost-store op for this stage's task durations:
+        sha1 of the stage plan's display with the job id scrubbed, so
+        repeated queries of the same shape share one rate across jobs (and
+        sibling tasks within one job warm it past MIN_OBSERVATIONS)."""
+        k = (job_id, stage_id)
+        op = self._task_op_cache.get(k)
+        if op is None:
+            from ballista_tpu.ops import costmodel
+
+            plan = self.get_stage_plan(job_id, stage_id)
+            shape = (
+                plan.display_indent() if plan is not None else f"s{stage_id}"
+            ).replace(job_id, "")
+            op = costmodel.task_run_op(shape)
+            if len(self._task_op_cache) > 10_000:
+                self._task_op_cache.clear()
+            self._task_op_cache[k] = op
+        return op
+
+    def _observe_task_run(self, job_id: str, stage_id: int, seconds: float) -> None:
+        from ballista_tpu.ops import costmodel
+
+        op = self._task_run_op(job_id, stage_id)
+        s_, n_ = self._task_rates.get(op, (0.0, 0))
+        if n_ >= 32:  # forget like the store: follow the current cluster
+            s_, n_ = s_ / 2.0, n_ // 2
+        if len(self._task_rates) > 10_000:
+            self._task_rates.clear()
+        self._task_rates[op] = (s_ + seconds, n_ + 1)
+        costmodel.observe(op, 1.0, seconds, engine="task")
+
+    def _predict_task_run(self, job_id: str, stage_id: int) -> Optional[float]:
+        """Predicted seconds for one task of this stage shape: the
+        scheduler-owned rates first (immune to cost-store rebinds), the
+        cost store as fallback (a restarted scheduler reloads persisted
+        rates before re-learning its own)."""
+        from ballista_tpu.ops import costmodel
+
+        op = self._task_run_op(job_id, stage_id)
+        local = self._task_rates.get(op)
+        if local is not None and local[1] >= costmodel.MIN_OBSERVATIONS:
+            return local[0] / local[1]
+        return costmodel.predict(op, 1.0, engine="task")
+
+    def maybe_speculate(
+        self, executor_id: str
+    ) -> Optional[Tuple[pb.TaskStatus, object]]:
+        """Cost-model straggler detection (ISSUE 11): pick ONE running task
+        whose elapsed time grossly exceeds its task.run prediction (slack
+        multiplier x predicted, past the minimum-runtime floor) and whose
+        owner is NOT `executor_id`, and hand back a speculative duplicate
+        (attempt N+1) for dispatch to this executor — recorded in the
+        durable speculation ledger, never in the task's own status (the
+        primary stays the current attempt; first completion wins). Returns
+        (status, bound plan) like assign_next_schedulable_task, or None.
+
+        Never speculates twice on one task, never on an executor that
+        failed a previous attempt of it, and never while the model has no
+        prediction (a cold store reproduces pre-speculation scheduling
+        exactly — which is also why fault-free runs with the default floor
+        launch nothing)."""
+        if not self._spec_enabled or not self._running_since:
+            return None
+        now = time.monotonic()
+        if self._speculative:
+            # sweep: a duplicate whose executor's lease lapsed is dead
+            # weight — the primary still runs, so just drop the record
+            alive = {m.id for m in self.get_executors_metadata()}
+            for k, entry in list(self._speculative.items()):
+                if entry[0] not in alive:
+                    self._spec_del(k)
+                    _record_speculation("executor_lost")
+        job_live: Dict[str, bool] = {}
+        inflight: Optional[Dict[str, int]] = None
+        for key3, (owner, attempt, t0) in sorted(self._running_since.items()):
+            if key3 in self._speculative or owner == executor_id:
+                continue
+            elapsed = now - t0
+            if elapsed < self._spec_floor_s:
+                continue
+            pred = self._predict_task_run(key3[0], key3[1])
+            if pred is None or elapsed <= self._spec_multiplier * max(pred, 1e-6):
+                continue
+            job_id, stage_id, partition = key3
+            if job_id not in job_live:
+                js = self.get_job_metadata(job_id)
+                job_live[job_id] = (
+                    js is not None and js.WhichOneof("status") == "running"
+                )
+            if not job_live[job_id]:
+                continue
+            if self._tenant_quota > 0:
+                # the rescue must not grant a saturated tenant an extra
+                # physical slot past its max_inflight bound (the duplicate
+                # writes no tasks/ status, so it is invisible to the
+                # in-flight accounting — gate on the primaries' count)
+                tenant = self.job_tenant(job_id)[0]
+                if inflight is None:
+                    inflight = self._tenant_inflight(self._ensure_task_index())
+                if inflight.get(tenant, 0) >= self._tenant_quota:
+                    _record_tenancy("speculate_quota_deferred")
+                    continue
+            # re-verify from the KV before dispatching: the watch map is
+            # in-memory and a peer (or a racing status) may have moved on
+            cur = self.get_task_status(*key3)
+            if (
+                cur is None
+                or cur.WhichOneof("status") != "running"
+                or cur.attempt != attempt
+                or cur.running.executor_id != owner
+            ):
+                self._running_since.pop(key3, None)
+                continue
+            if any(h.executor_id == executor_id for h in cur.history):
+                # this executor already failed an attempt of the task;
+                # don't bet the tail-latency rescue on it
+                continue
+            idx = self._ensure_task_index()
+            bound = self._bound_stage_plan(job_id, stage_id, idx)
+            if bound is None:
+                continue
+            dup = pb.TaskStatus()
+            dup.partition_id.CopyFrom(cur.partition_id)
+            dup.attempt = cur.attempt + 1
+            dup.speculative = True
+            self._spec_put(key3, executor_id, dup.attempt)
+            self.note_tenant_assigned(self.job_tenant(job_id)[0])
+            _record_speculation("launched")
+            log.warning(
+                "speculating %s/%s/%s on %s (attempt %d): elapsed %.3fs > "
+                "%.1fx predicted %.3fs (primary %s)",
+                job_id, stage_id, partition, executor_id, dup.attempt,
+                elapsed, self._spec_multiplier, pred, owner,
+            )
+            return dup, bound
+        return None
+
+    def _note_job_slo(self, job_id: str) -> None:
+        """SLO accounting at job completion (ISSUE 11): a job finishing
+        past its tenant's ballista.tenant.slo_ms deadline counts one
+        slo_misses event. Once per job, enforced here — restart_completed_
+        job can un-terminate a job (lost result partitions), and the
+        second fold must not count the same job's outcome twice."""
+        if not self._tenant_slos:
+            return
+        if job_id in self._slo_noted:
+            return
+        if len(self._slo_noted) > 10_000:
+            self._slo_noted.clear()
+        self._slo_noted.add(job_id)
+        tenant, _prio, created = self._job_tenant_full(job_id)
+        slo = self._tenant_slos.get(tenant)
+        if slo is None or created <= 0.0:
+            return
+        if (time.time() - created) * 1000.0 > slo:
+            _record_speculation("slo_misses")
+            log.warning(
+                "job %s (tenant %s) missed its %.0fms SLO", job_id, tenant, slo
+            )
+        else:
+            _record_speculation("slo_met")
+
     def _tenant_inflight(self, idx: _TaskIndex) -> Dict[str, int]:
         """Per-tenant totals of currently RUNNING tasks, via the index's
         per-stage running sets and the job->tenant map."""
@@ -1055,9 +1594,46 @@ class SchedulerState:
             by_tenant.setdefault(tenant, []).append(key)
             prios[key[0]] = prio
         order: List[Tuple[str, int]] = []
+        # deadline-aware layer (ISSUE 11): a tenant whose oldest pending
+        # job has blown its ballista.tenant.slo_ms deadline jumps ahead of
+        # the fair-share order (most overdue first); everyone else — and
+        # every deployment with no SLOs configured — keeps the exact
+        # weighted fair-share ranking below.
+        overdue: Dict[str, float] = {}
+        if self._tenant_slos:
+            now = time.time()
+            for tenant, keys in by_tenant.items():
+                slo = self._tenant_slos.get(tenant)
+                if slo is None:
+                    continue
+                headrooms = [
+                    created + slo / 1000.0 - now
+                    for created in (
+                        self.job_created_at(j) for j in {k[0] for k in keys}
+                    )
+                    if created > 0.0
+                ]
+                if headrooms and min(headrooms) <= 0.0:
+                    overdue[tenant] = min(headrooms)
+                    last = self._slo_boosted.get(tenant)
+                    if last is None or now - last > 5.0:
+                        # a fresh episode: never boosted, or unseen for
+                        # long enough that the prior episode ended (a
+                        # sub-5s gap is a stage boundary draining the
+                        # pending set, not relief)
+                        _record_tenancy("admit_slo_boosted")
+                    self._slo_boosted[tenant] = now
+            for t in by_tenant:
+                # evaluated this scan and NOT overdue: episode over
+                if t not in overdue:
+                    self._slo_boosted.pop(t, None)
         tenant_rank = sorted(
             by_tenant,
-            key=lambda t: (inflight.get(t, 0) / weights.get(t, 1), t),
+            key=lambda t: (
+                (0, overdue[t]) if t in overdue
+                else (1, inflight.get(t, 0) / weights.get(t, 1)),
+                t,
+            ),
         )
         for tenant in tenant_rank:
             if quota > 0 and inflight.get(tenant, 0) >= quota:
@@ -1111,49 +1687,9 @@ class SchedulerState:
                 )
             if not job_live[job_id]:
                 continue
-            plan = self.get_stage_plan(job_id, stage_id)
-            if plan is None:
+            bound = self._bound_stage_plan(job_id, stage_id, idx)
+            if bound is None:
                 continue
-            unresolved = find_unresolved_shuffles(plan)
-            locations: Dict[int, List[ShuffleLocation]] = {}
-            blocked = False
-            for u in unresolved:
-                # O(1) screen: stages the index knows are incomplete skip
-                # the KV read entirely (staleness toward "peer completed
-                # it" is bounded by the periodic reseed)
-                if not idx.stage_done(job_id, u.stage_id):
-                    blocked = True
-                    break
-                # the locations are built from FRESH KV statuses with a
-                # final completeness check — a peer's lost-task reset
-                # (completed -> pending, unseen by this index) must block
-                # the stage, not hand out empty executor/path locations
-                upstream = self.get_stage_tasks(job_id, u.stage_id)
-                for t in upstream:
-                    idx.observe(t)
-                if not upstream or any(
-                    t.WhichOneof("status") != "completed" for t in upstream
-                ):
-                    blocked = True
-                    break
-                locs = []
-                for t in sorted(upstream, key=lambda t: t.partition_id.partition_id):
-                    meta = self.get_executor_metadata(t.completed.executor_id)
-                    host, port = (meta.host, meta.port) if meta else ("", 0)
-                    locs.append(
-                        ShuffleLocation(
-                            t.completed.executor_id,
-                            host,
-                            port,
-                            t.completed.path,
-                            stage_id=u.stage_id,
-                            map_partition=t.partition_id.partition_id,
-                        )
-                    )
-                locations[u.stage_id] = locs
-            if blocked:
-                continue
-            bound = remove_unresolved_shuffles(plan, locations) if unresolved else plan
             for partition in sorted(parts, key=str):
                 # re-verify from the KV before claiming: the index is local
                 # to this SchedulerState; a peer scheduler (or an expired
@@ -1225,6 +1761,31 @@ class SchedulerState:
                 pid = p.partition_id
                 echo[(pid.job_id, pid.stage_id, pid.partition_id)] = p.attempt
         reclaimed = 0
+        # speculative-duplicate reconciliation (ISSUE 11): the duplicate
+        # has no tasks/ status, so the ledger entry under speculation/ is
+        # the only thing that notices a lost-in-transit delivery. The
+        # owner's echo with the speculative attempt confirms it (and, after
+        # a restart, re-adopts it); an unvouched entry past the grace
+        # window is simply dropped — the primary still runs, so there is
+        # nothing to requeue.
+        for key, entry in list(self._speculative.items()):
+            ex, at, t0, vouched, restored = entry
+            if ex != executor_id:
+                continue
+            if key in echo and echo[key] in (None, at):
+                if not vouched:
+                    self._speculative[key] = (ex, at, t0, True, restored)
+                    if restored:
+                        _record_recovery("restart_speculation_readopted")
+                continue
+            if not vouched and now - t0 > ORPHANED_ASSIGNMENT_GRACE_SECS:
+                self._spec_del(key)
+                _record_speculation("orphaned")
+                log.warning(
+                    "speculative attempt %d of %s/%s/%s never reached %s; "
+                    "dropped (primary still runs)",
+                    at, key[0], key[1], key[2], ex,
+                )
         # in-memory screens first (owner, echo confirmation, grace window):
         # the KV read + proto parse happens ONLY for entries actually up
         # for requeue — this loop runs under the global lock on every poll,
@@ -1354,6 +1915,7 @@ class SchedulerState:
                 pl.partition_stats.CopyFrom(t.completed.stats)
         self.save_job_metadata(job_id, status)
         if status.WhichOneof("status") == "completed":
+            self._note_job_slo(job_id)
             # publish into the plan-fingerprint result cache (ISSUE 7).
             # jobfp/{job} exists only when the submission was fingerprintable
             # AND caching was enabled for it — so this is already gated.

@@ -63,6 +63,14 @@ SITES = (
                              # — a torn load is recorded with a reason and
                              # falls back to a fresh trace/compile, like a
                              # corrupted or version-mismatched artifact
+    "task.slow",             # deterministic straggler injection (ISSUE 11,
+                             # execution_loop.py): a task whose (stage,
+                             # partition, attempt) coordinate draws a slow
+                             # verdict sleeps ballista.chaos.slow_ms before
+                             # executing — the seeded tail the speculation
+                             # subsystem must beat. Non-raising: the task
+                             # still completes correctly, just late, so
+                             # results stay bit-identical by construction.
 )
 
 _DENOM = float(1 << 64)

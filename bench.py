@@ -15,7 +15,10 @@ Prints ONE JSON line:
                faults), and "routing" (adaptive-execution decisions:
                engine choice counts, predicted vs observed seconds,
                mispredict rate, partial-offload splits, skew re-plans —
-               ops/costmodel.py)]}
+               ops/costmodel.py), and "speculation" (ISSUE 11 duplicate-
+               attempt events: launched/won/lost/wasted_seconds plus the
+               per-tenant SLO outcomes — zero on fault-free runs with the
+               default thresholds)]}
 
 Reference baseline context: the reference publishes no numbers
 (BASELINE.md); the denominator here is this repo's own host Arrow path —
@@ -373,6 +376,27 @@ def _routing_snapshot() -> dict | None:
     }
 
 
+def _speculation_snapshot() -> dict | None:
+    """Drain the speculative-execution accumulator (ops/runtime.py):
+    duplicate-attempt launches and their outcomes (won/lost/failed/
+    promoted/orphaned), the duplicated compute discarded when a pair
+    resolves (wasted_seconds), and per-tenant SLO outcomes (slo_misses /
+    slo_met) since the last drain. Raw event TOTALS like the recovery
+    block — speculation is driven by stragglers, not the query loop. None
+    on a fault-free run (the acceptance default: every counter zero)."""
+    try:
+        from ballista_tpu.ops.runtime import speculation_stats
+
+        s = speculation_stats(reset=True)
+    except Exception:
+        return None
+    s = {
+        k: (round(v, 4) if k == "wasted_seconds" else int(v))
+        for k, v in s.items() if v
+    }
+    return s or None
+
+
 def _ingest_snapshot() -> dict | None:
     """Drain the ingest-timing accumulator (ops/runtime.py): scan/encode/
     upload seconds and the overlap fraction of the stage prepares since the
@@ -413,11 +437,13 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
         _join_snapshot()  # drain: attribute join paths to the timed runs
         _recovery_snapshot()  # drain: attribute recovery events likewise
         _routing_snapshot()  # drain: attribute routing decisions likewise
+        _speculation_snapshot()  # drain: attribute speculation likewise
         t = min(run_once("tpu", sql, sf) for _ in range(iters))
         readback = _per_query(_readback_snapshot(), iters)
         join_paths = _join_snapshot(iters)
         recovery = _recovery_snapshot()
         routing = _routing_snapshot()
+        speculation = _speculation_snapshot()
         run_once("cpu", sql, sf)
         c = min(run_once("cpu", sql, sf) for _ in range(iters))
     except Exception as e:
@@ -462,6 +488,10 @@ def bench_config(sf: float, name: str, iters: int = 3) -> dict | None:
               f"mispredict_rate={routing['mispredict_rate']} "
               f"splits={routing['splits']} "
               f"skew_replans={routing['skew_replans']} (decision totals)",
+              file=sys.stderr)
+    if speculation is not None:
+        row["speculation"] = speculation
+        print(f"[speculation] {name} sf={sf}: {speculation} (event totals)",
               file=sys.stderr)
     print(f"[config] {name} sf={sf}: tpu={row['tpu_ms']}ms "
           f"cpu={row['cpu_ms']}ms speedup={row['speedup']}x", file=sys.stderr)
@@ -637,6 +667,126 @@ def _multitenant_scenario() -> dict | None:
         cluster.shutdown()
 
 
+# -- multi-process closed-loop client driver (ISSUE 11 satellite) ------------
+# the thread driver saturates CPU images at ~2 workers (client-side Arrow +
+# Flight decode competes with the in-process executors for the GIL and the
+# cores), making high-concurrency p99 numbers client-bound. Workers here are
+# real processes talking to the parent's cluster over gRPC/Flight; each
+# times its own loop, so spawn/import overhead never lands in a latency
+# sample. Module-level on purpose: spawned children pickle these by
+# reference.
+
+
+def _timed_stream_query(ctx, sql: str):
+    """(total_s, ttfb_s) for one streamed query; None on no rows."""
+    plan = ctx.sql(sql).logical_plan()
+    t0 = time.perf_counter()
+    ttfb = None
+    rows = 0
+    for b in ctx.collect_stream(plan, timeout=120):
+        if ttfb is None:
+            ttfb = time.perf_counter() - t0
+        rows += b.num_rows
+    total = time.perf_counter() - t0
+    return (total, ttfb if ttfb is not None else total) if rows else None
+
+
+def _client_proc(host, port, data, settings, qlist, idx, duration, out_q,
+                 digest) -> None:
+    """One closed-loop client process. With digest=True results are
+    buffered-collected and content-hashed so the parent can assert
+    bit-identity across the process boundary without shipping tables."""
+    try:
+        import hashlib
+
+        from ballista_tpu.client import BallistaContext
+        from benchmarks.tpch.datagen import register_all
+
+        ctx = BallistaContext(host, port, settings=settings)
+        register_all(ctx, data)
+        lats, ttfbs, digests = [], [], set()
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < duration:
+            sql = qlist[(idx + n) % len(qlist)]
+            n += 1
+            if digest:
+                q0 = time.perf_counter()
+                tbl = ctx.sql(sql).collect()
+                dt = time.perf_counter() - q0
+                if tbl.num_rows == 0:
+                    out_q.put(("error", idx, "empty result"))
+                    return
+                lats.append(dt)
+                ttfbs.append(dt)
+                digests.add(
+                    hashlib.sha256(repr(tbl.to_pydict()).encode()).hexdigest()
+                )
+            else:
+                r = _timed_stream_query(ctx, sql)
+                if r is None:
+                    out_q.put(("error", idx, "empty result"))
+                    return
+                lats.append(r[0])
+                ttfbs.append(r[1])
+        wall = time.perf_counter() - t0
+        ctx.close()
+        out_q.put(("ok", idx, lats, ttfbs, wall, sorted(digests)))
+    except Exception as e:
+        out_q.put(("error", idx, repr(e)))
+
+
+def _drive_clients(host, port, data, settings, qlist, clients, duration,
+                   digest=False):
+    """Run `clients` closed-loop client processes against the scheduler at
+    (host, port); returns (lats, ttfbs, qps, digests) or raises
+    RuntimeError naming the failures. qps sums each worker's own
+    samples/wall (workers start staggered by spawn cost; a shared parent
+    clock would undercount)."""
+    import multiprocessing as mp
+
+    mpctx = mp.get_context("spawn")  # never fork a process running grpc/jax
+    out_q = mpctx.Queue()
+    procs = [
+        mpctx.Process(
+            target=_client_proc,
+            args=(host, port, data, settings, qlist, i, duration, out_q,
+                  digest),
+            daemon=True,
+        )
+        for i in range(clients)
+    ]
+    for p in procs:
+        p.start()
+    lats, ttfbs, qps, digests, errors = [], [], 0.0, set(), []
+    got = 0
+    deadline = time.monotonic() + duration + 240
+    while got < clients and time.monotonic() < deadline:
+        try:
+            msg = out_q.get(timeout=max(0.1, deadline - time.monotonic()))
+        except Exception:
+            break
+        got += 1
+        if msg[0] == "error":
+            errors.append(f"client{msg[1]}: {msg[2]}")
+            continue
+        _tag, _idx, ls, ts, wall, ds = msg
+        lats.extend(ls)
+        ttfbs.extend(ts)
+        qps += len(ls) / max(wall, 1e-9)
+        digests.update(ds)
+    for p in procs:
+        p.join(10)
+        if p.is_alive():
+            errors.append("client process still running; terminated")
+            p.terminate()
+    if got < clients and not errors:
+        errors.append(f"only {got}/{clients} clients reported")
+    if errors or not lats:
+        raise RuntimeError(str(errors or ["no samples"]))
+    return lats, ttfbs, qps, digests
+
+
 def _latency_scenario() -> dict | None:
     """Low-latency serving-tier scenario (ISSUE 8): closed-loop QPS sweep
     of SF=0.01-0.1 point-lookup/filter queries against ONE standalone
@@ -652,7 +802,9 @@ def _latency_scenario() -> dict | None:
     concurrency level (default 10; the CI smoke uses 2), BENCH_LAT_CLIENTS
     (default "1,4"), BENCH_LAT_BACKEND (default tpu — the compile counters
     only mean something where stage programs compile; runs under
-    JAX_PLATFORMS=cpu too)."""
+    JAX_PLATFORMS=cpu too), BENCH_LAT_DRIVER ("process" default — each
+    client is its own OS process so the load generator is never
+    client-bound; "thread" keeps the pre-ISSUE-11 in-process driver)."""
     import threading
 
     from ballista_tpu.client import BallistaContext
@@ -698,84 +850,84 @@ def _latency_scenario() -> dict | None:
             "ballista.cache.results": "false",
         }),
     )
+    client_settings = {
+        "ballista.executor.backend": backend,
+        "ballista.cache.results": "false",
+        "ballista.client.stream_results": "true",
+        # serving-tier plan shape: a 16-way shuffle is pure overhead for
+        # point queries (16 final-stage tasks per query, each with its own
+        # dispatch + status + fetch)
+        "ballista.shuffle.partitions": "2",
+    }
+    driver = os.environ.get("BENCH_LAT_DRIVER", "process")
     try:
         def mk_ctx() -> BallistaContext:
             ctx = BallistaContext(
-                *cluster.scheduler_addr,
-                settings={
-                    "ballista.executor.backend": backend,
-                    "ballista.cache.results": "false",
-                    "ballista.client.stream_results": "true",
-                    # serving-tier plan shape: a 16-way shuffle is pure
-                    # overhead for point queries (16 final-stage tasks per
-                    # query, each with its own dispatch + status + fetch)
-                    "ballista.shuffle.partitions": "2",
-                },
+                *cluster.scheduler_addr, settings=client_settings
             )
             register_all(ctx, str(d))
             return ctx
 
-        def timed_query(ctx, sql: str) -> tuple[float, float] | None:
-            """(total_s, ttfb_s) for one streamed query; None on no rows."""
-            import pyarrow as pa
-
-            plan = ctx.sql(sql).logical_plan()
-            t0 = time.perf_counter()
-            ttfb = None
-            batches = []
-            for b in ctx.collect_stream(plan, timeout=120):
-                if ttfb is None:
-                    ttfb = time.perf_counter() - t0
-                batches.append(b)
-            total = time.perf_counter() - t0
-            rows = sum(b.num_rows for b in batches)
-            return (total, ttfb if ttfb is not None else total) if rows else None
-
         warm_ctx = mk_ctx()
         for sql in queries.values():  # warmup: trace/compile + caches
-            timed_query(warm_ctx, sql)
+            _timed_stream_query(warm_ctx, sql)
         warm_ctx.close()
         warm = serving_stats(reset=True)  # drain: attribute to timed sweep
 
         sweep = []
         qlist = list(queries.values())
+        host, port = cluster.scheduler_addr
         for clients in levels:
             lat: list = []
             ttfbs: list = []
             errors: list = []
-            lock = threading.Lock()
-
-            def worker(i: int) -> None:
+            qps = 0.0
+            if driver == "process":
                 try:
-                    ctx = mk_ctx()
-                    n = 0
-                    while time.perf_counter() - t0 < duration:
-                        r = timed_query(ctx, qlist[(i + n) % len(qlist)])
-                        n += 1
-                        if r is None:
-                            errors.append(f"client{i}: empty result")
-                            return
-                        with lock:
-                            lat.append(r[0])
-                            ttfbs.append(r[1])
-                    ctx.close()
-                except Exception as e:
-                    errors.append(f"client{i}: {e}")
+                    lat, ttfbs, qps, _digests = _drive_clients(
+                        host, port, str(d), client_settings, qlist,
+                        clients, duration,
+                    )
+                except RuntimeError as e:
+                    print(f"[latency] clients={clients}: {e}", file=sys.stderr)
+                    return None
+            else:
+                lock = threading.Lock()
 
-            threads = [
-                threading.Thread(target=worker, args=(i,))
-                for i in range(clients)
-            ]
-            t0 = time.perf_counter()
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join(duration + 240)
-            wall = time.perf_counter() - t0
-            if errors or not lat:
-                print(f"[latency] clients={clients}: "
-                      f"{errors or ['no samples']}", file=sys.stderr)
-                return None
+                def worker(i: int) -> None:
+                    try:
+                        ctx = mk_ctx()
+                        n = 0
+                        while time.perf_counter() - t0 < duration:
+                            r = _timed_stream_query(
+                                ctx, qlist[(i + n) % len(qlist)]
+                            )
+                            n += 1
+                            if r is None:
+                                errors.append(f"client{i}: empty result")
+                                return
+                            with lock:
+                                lat.append(r[0])
+                                ttfbs.append(r[1])
+                        ctx.close()
+                    except Exception as e:
+                        errors.append(f"client{i}: {e}")
+
+                threads = [
+                    threading.Thread(target=worker, args=(i,))
+                    for i in range(clients)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(duration + 240)
+                wall = time.perf_counter() - t0
+                qps = len(lat) / max(wall, 1e-9)
+                if errors or not lat:
+                    print(f"[latency] clients={clients}: "
+                          f"{errors or ['no samples']}", file=sys.stderr)
+                    return None
             lat.sort()
             ttfbs.sort()
 
@@ -785,7 +937,7 @@ def _latency_scenario() -> dict | None:
             row = {
                 "clients": clients,
                 "queries": len(lat),
-                "qps": round(len(lat) / wall, 1),
+                "qps": round(qps, 1),
                 "p50_ms": pct(lat, 0.50),
                 "p95_ms": pct(lat, 0.95),
                 "p99_ms": pct(lat, 0.99),
@@ -801,6 +953,7 @@ def _latency_scenario() -> dict | None:
         result = {
             "sf": sf,
             "duration_s": duration,
+            "driver": driver,
             "sweep": sweep,
             "dispatch_push": s.get("dispatch_push", 0),
             "dispatch_poll": s.get("dispatch_poll", 0),
@@ -816,6 +969,169 @@ def _latency_scenario() -> dict | None:
         return result
     finally:
         cluster.shutdown()
+
+
+def _speculation_scenario() -> dict | None:
+    """Straggler-tail scenario (ISSUE 11): p99-under-chaos with speculation
+    ON vs OFF. One query shape replays closed-loop (multi-process clients)
+    against a 2-executor cluster whose tasks inject a seeded `task.slow`
+    straggler. Chaos verdicts are keyed on plan coordinates — never job
+    ids — so the chosen seed makes the straggler recur every repetition
+    (and makes the duplicate attempt, keyed on attempt 1, draw fast): with
+    speculation OFF every hit query eats the full injected delay; ON, the
+    duplicate rescues the tail and p99 must land strictly below OFF. Both
+    modes must stay bit-identical to the fault-free baseline — the rescue
+    changes when a query finishes, never what it returns. Also reports the
+    per-tenant SLO outcomes (ballista.tenant.slo_ms armed at ~0.8x the
+    injected delay) and asserts-by-counter that the fault-free warm pass
+    launched nothing.
+
+    Knobs: BENCH_SPEC_SF (default 0.01), BENCH_SPEC_DURATION seconds per
+    mode (default 8; the CI smoke uses 4), BENCH_SPEC_CLIENTS (default 2),
+    BENCH_SPEC_SLOW_MS (default 1200)."""
+    import hashlib
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.ops.runtime import speculation_stats
+    from ballista_tpu.utils.chaos import ChaosInjector
+    from benchmarks.tpch.datagen import generate, is_complete, register_all
+
+    sf = float(os.environ.get("BENCH_SPEC_SF", "0.01"))
+    duration = float(os.environ.get("BENCH_SPEC_DURATION", "8"))
+    clients = int(os.environ.get("BENCH_SPEC_CLIENTS", "2"))
+    slow_ms = float(os.environ.get("BENCH_SPEC_SLOW_MS", "1200"))
+    rate = 0.12
+    d = REPO / ".bench_cache" / f"tpch_lat{sf}"  # share the latency dataset
+    if not is_complete(str(d)):
+        d.parent.mkdir(exist_ok=True)
+        generate(str(d), sf=sf, parts=2)
+    sql = ("select l_returnflag, count(*) as n, sum(l_extendedprice) as s "
+           "from lineitem group by l_returnflag order by l_returnflag")
+    # every config (cluster AND per-job) pins the in-memory cost store so
+    # no configure() rebind drops the task.run rates between passes
+    client_base = {
+        "ballista.cache.results": "false",
+        "ballista.shuffle.partitions": "2",
+        "ballista.tpu.cost_model_dir": "",
+        "ballista.tenant.name": "bench",
+    }
+
+    def run_mode(spec_on: bool, seed: int | None):
+        cluster = StandaloneCluster(
+            n_executors=2,
+            config=BallistaConfig({
+                "ballista.tpu.cost_model_dir": "",
+                "ballista.speculation": "true" if spec_on else "false",
+                "ballista.speculation.min_runtime_ms": "150",
+                "ballista.speculation.multiplier": "3",
+                "ballista.tenant.slo_ms":
+                    f"bench:{max(200.0, slow_ms * 0.8):.0f}",
+            }),
+        )
+        try:
+            host, port = cluster.scheduler_addr
+            speculation_stats(reset=True)
+            ctx = BallistaContext(host, port, settings=client_base)
+            register_all(ctx, str(d))
+            # fault-free warm pass: compiles, caches, and the
+            # job-independent task.run rates the straggler monitor
+            # predicts from (the chaos run's jobs share the plan shape)
+            baseline = None
+            for _ in range(3):
+                baseline = ctx.sql(sql).collect()
+            ctx.close()
+            base_digest = hashlib.sha256(
+                repr(baseline.to_pydict()).encode()
+            ).hexdigest()
+            warm_stats = speculation_stats(reset=True)
+            if seed is None:
+                # pick the seed off the warm run's real task coordinates:
+                # exactly one straggler per repetition, duplicate fast
+                st = cluster.scheduler_impl.state
+                coords = set()
+                for k, _v in st.kv.get_prefix(st._key("tasks")):
+                    tail = k.rsplit("/", 3)
+                    coords.add((int(tail[2]), int(tail[3])))
+                for cand in range(2000):
+                    inj = ChaosInjector(cand, rate, sites=("task.slow",))
+                    slow = [
+                        c for c in sorted(coords)
+                        if inj.should_inject("task.slow", f"{c[0]}/{c[1]}@a0")
+                    ]
+                    if len(slow) == 1 and not inj.should_inject(
+                        "task.slow", f"{slow[0][0]}/{slow[0][1]}@a1"
+                    ):
+                        seed = cand
+                        break
+                if seed is None:
+                    return None, None
+            lats, _ttfbs, qps, digests = _drive_clients(
+                host, port, str(d),
+                {
+                    **client_base,
+                    "ballista.chaos.rate": str(rate),
+                    "ballista.chaos.seed": str(seed),
+                    "ballista.chaos.sites": "task.slow",
+                    "ballista.chaos.slow_ms": str(slow_ms),
+                },
+                [sql], clients, duration, digest=True,
+            )
+            stats = speculation_stats(reset=True)
+            lats.sort()
+
+            def pct(q):
+                return round(
+                    1000 * lats[min(len(lats) - 1, int(len(lats) * q))], 1
+                )
+
+            return {
+                "queries": len(lats),
+                "qps": round(qps, 1),
+                "p50_ms": pct(0.50),
+                "p99_ms": pct(0.99),
+                "bit_identical": digests == {base_digest},
+                "warm_launched": int(warm_stats.get("launched", 0)),
+                "speculation": {
+                    k: (round(v, 4) if k == "wasted_seconds" else int(v))
+                    for k, v in stats.items()
+                },
+            }, seed
+        finally:
+            cluster.shutdown()
+            costmodel.reset()
+
+    costmodel.reset()
+    try:
+        on, seed = run_mode(True, None)
+        if on is None:
+            print("[speculation] no qualifying chaos seed", file=sys.stderr)
+            return None
+        off, _ = run_mode(False, seed)
+        if off is None:
+            return None
+    except RuntimeError as e:
+        print(f"[speculation] client driver failed: {e}", file=sys.stderr)
+        return None
+    result = {
+        "sf": sf,
+        "duration_s": duration,
+        "clients": clients,
+        "slow_ms": slow_ms,
+        "chaos_rate": rate,
+        "chaos_seed": seed,
+        "on": on,
+        "off": off,
+        "bit_identical": on["bit_identical"] and off["bit_identical"],
+        "p99_speedup": round(off["p99_ms"] / max(on["p99_ms"], 1e-9), 2),
+    }
+    print(f"[speculation] ON p99={on['p99_ms']}ms OFF p99={off['p99_ms']}ms "
+          f"({result['p99_speedup']}x) bit_identical="
+          f"{result['bit_identical']} counters={on['speculation']}",
+          file=sys.stderr)
+    return result
 
 
 def _routing_scenario() -> dict | None:
@@ -896,6 +1212,10 @@ def main() -> None:
     if os.environ.get("BENCH_LATENCY_ONLY"):
         # serving-tier scenario only: runs without a reachable device
         print(json.dumps({"latency": _latency_scenario()}))
+        return
+    if os.environ.get("BENCH_SPECULATION_ONLY"):
+        # straggler-tail scenario only: runs without a reachable device
+        print(json.dumps({"speculation": _speculation_scenario()}))
         return
     if os.environ.get("BENCH_MULTITENANT_ONLY"):
         # control-plane scenario only: runs without a reachable device
@@ -982,6 +1302,14 @@ def main() -> None:
             latency = None
         if latency is not None:
             result["latency"] = latency
+    if time.monotonic() - _T_START <= MAX_SECONDS:
+        try:
+            speculation = _speculation_scenario()
+        except Exception as e:
+            print(f"[speculation] failed: {e}", file=sys.stderr)
+            speculation = None
+        if speculation is not None:
+            result["speculation"] = speculation
     try:
         import jax
 

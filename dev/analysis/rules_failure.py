@@ -1,6 +1,6 @@
 """failure-discipline: the failure-recovery paths stay analyzable.
 
-Two invariants (ISSUE 5), scoped to the whole ballista_tpu package:
+Three invariants (ISSUE 5/11), scoped to the whole ballista_tpu package:
 
 1. A `fetch_failed` status must CARRY THE LOST LOCATION. Any function that
    assigns `<status>.fetch_failed.error` must also assign
@@ -13,6 +13,14 @@ Two invariants (ISSUE 5), scoped to the whole ballista_tpu package:
    `ballista_tpu/utils/chaos.py::SITES`, and `ChaosInjected` may only be
    raised by the injector itself — ad-hoc raises (or `random`-driven ones)
    are invisible to the registry and break chaos-run determinism.
+
+3. Speculative duplicates must FLOW THROUGH THE LEDGER (ISSUE 11). A scope
+   that MINTS a speculative attempt — assigns a literal `True` to a
+   `.speculative` field — must also record it durably in the same scope
+   (`_spec_put`, or `_ledger_put` for a promotion into the assignment
+   ledger). An ad-hoc second-attempt path is invisible to scheduler-restart
+   recovery and to the first-completion-wins bookkeeping; echo sites
+   (`td.speculative = flag`) copy a non-literal and are exempt.
 """
 
 from __future__ import annotations
@@ -27,6 +35,10 @@ from dev.analysis.core import Finding, SourceFile, register
 _INJECTOR_METHODS = {"maybe_fail", "should_inject"}
 _CHAOS_MODULE_SUFFIX = "ballista_tpu/utils/chaos.py"
 
+# durable-record calls that legitimize a minted speculative attempt: the
+# speculation ledger itself, or the assignment ledger for a promotion
+_SPEC_LEDGER_METHODS = {"_spec_put", "_ledger_put"}
+
 # fallback if chaos.py cannot be located from the scanned file (fixtures
 # analyzed outside the repo tree); keep in sync with utils/chaos.py::SITES
 _DEFAULT_SITES = frozenset(
@@ -34,6 +46,7 @@ _DEFAULT_SITES = frozenset(
         "flight.fetch", "rpc.call", "task.execute", "kv.put",
         "executor.death", "scheduler.plan_write", "scheduler.crash",
         "cache.put", "scheduler.admit", "scheduler.push", "aot.load",
+        "task.slow",
     }
 )
 
@@ -128,6 +141,42 @@ def check(sf: SourceFile) -> List[Finding]:
                 "fetch_failed status without the lost location (missing "
                 f"{', '.join(missing)}) — the scheduler cannot recompute "
                 "the lost map partition from an anonymous fetch failure",
+            ))
+
+    # -- 3. speculative attempts must flow through the ledger ----------------
+    # a scope assigning a LITERAL True to `.speculative` is minting a new
+    # duplicate attempt (echo sites copy a flag, a non-literal); without a
+    # same-scope _spec_put/_ledger_put the attempt is invisible to restart
+    # recovery and to first-completion-wins bookkeeping
+    for scope in _scopes(sf.tree):
+        mint = None
+        ledgered = False
+        for node in walk_no_nested_defs(scope):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and t.attr == "speculative"
+                        and isinstance(node.value, ast.Constant)
+                        and node.value.value is True
+                        and mint is None
+                    ):
+                        mint = node
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SPEC_LEDGER_METHODS
+            ):
+                ledgered = True
+        if mint is not None and not ledgered:
+            findings.append(Finding(
+                "failure-discipline", sf.path,
+                mint.lineno, mint.col_offset,
+                "ad-hoc speculative attempt: `.speculative = True` without "
+                "a durable ledger record in the same scope — duplicate "
+                "dispatch must flow through _spec_put (or _ledger_put for "
+                "a promotion) so restart recovery and first-completion-"
+                "wins bookkeeping can see it",
             ))
 
     # -- 2. chaos sites must be registered ----------------------------------

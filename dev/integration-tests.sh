@@ -127,6 +127,45 @@ print("routing smoke OK:", {k: rec[k] for k in
                             ("engines", "mispredict_rate", "splits")})
 PY
 
+# strict gate on speculative execution (ISSUE 11): cost-model straggler
+# detection launching duplicates through the durable speculation ledger,
+# first-completion-wins in both directions (the losing sibling's report
+# dropped by the stale guards, never double-counted), primary-failure
+# promotion of the in-flight duplicate, scheduler crash+restart recovering
+# BOTH attempts from the ledger, deadline-aware (SLO) admission, the
+# scale-normalized stage.run units, the end-to-end seeded-straggler
+# rescue, and the speculation fuzz slice (random 2-stage plans under
+# task.slow chaos, bit-identical to fault-free).
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_speculation.py \
+    "tests/test_fuzz_device.py::test_fuzz_speculation_straggler"
+
+# speculation bench smoke (ISSUE 11): seeded task.slow chaos in the
+# closed-loop latency harness (multi-process client driver) — p99 with
+# speculation ON must land STRICTLY below OFF, results bit-identical to
+# the fault-free baseline in both modes, counters emitted, and the
+# fault-free warm passes must launch nothing.
+JAX_PLATFORMS=cpu BENCH_SPECULATION_ONLY=1 BENCH_SPEC_DURATION=4 \
+    BENCH_SPEC_SLOW_MS=800 python bench.py > /tmp/_ballista_spec_smoke.json
+python - /tmp/_ballista_spec_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["speculation"]
+assert rec is not None, "speculation scenario returned no record"
+assert rec["bit_identical"], "speculation changed results"
+on, off = rec["on"], rec["off"]
+assert on["p99_ms"] < off["p99_ms"], (
+    f"speculation ON p99 {on['p99_ms']}ms not below OFF {off['p99_ms']}ms")
+assert on["speculation"].get("launched", 0) > 0, on
+assert on["speculation"].get("won", 0) >= 1, on
+assert off["speculation"].get("launched", 0) == 0, off
+# fault-free runs launch nothing: both modes' warm passes stayed silent
+assert on["warm_launched"] == 0 and off["warm_launched"] == 0, rec
+print("speculation smoke OK:",
+      {"on_p99_ms": on["p99_ms"], "off_p99_ms": off["p99_ms"],
+       "p99_speedup": rec["p99_speedup"],
+       "counters": on["speculation"]})
+PY
+
 # latency harness smoke (ISSUE 8): tiny QPS, 2s budget per level — the
 # p50/p99 + time-to-first-batch + dispatch/compile-counter pipeline is
 # exercised end-to-end on CPU images even though the absolute numbers only

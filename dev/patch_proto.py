@@ -248,6 +248,39 @@ def edit_issue8_latency_tier(fdp) -> None:
         m.server_streaming = True
 
 
+def edit_issue11_speculation(fdp) -> None:
+    """ISSUE 11: speculative execution + SLOs + push job-status.
+
+    Adds (all wire-compatible field/method additions):
+    - TaskDefinition.speculative + TaskStatus.speculative: attempt
+      provenance — the scheduler marks a duplicate (speculative) dispatch
+      and the executor echoes the mark in its reported status, so logs,
+      counters, and the first-completion-wins bookkeeping can tell the
+      duplicate from the primary without decoding attempt arithmetic
+    - JobTenant.created_at: job submission time, the anchor for the
+      per-tenant SLO deadline (ballista.tenant.slo_ms) that feeds
+      deadline-aware admission ordering and the slo_misses counter
+    - the server-streaming SubscribeJobStatus RPC (mirroring
+      SubscribeWork): the scheduler pushes a GetJobStatusResult on every
+      job-status transition, replacing the client's 5ms-floor status poll
+      (which stays as the automatic fallback)
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+    DBL, BOOL = 1, 8  # FieldDescriptorProto.Type
+
+    add_field(msgs["TaskDefinition"], "speculative", 5, BOOL)
+    add_field(msgs["TaskStatus"], "speculative", 8, BOOL)
+    add_field(msgs["JobTenant"], "created_at", 3, DBL)
+
+    svc = {s.name: s for s in fdp.service}.get("SchedulerGrpc")
+    if svc is not None:
+        m = svc.method.add()
+        m.name = "SubscribeJobStatus"
+        m.input_type = ".ballista.GetJobStatusParams"
+        m.output_type = ".ballista.GetJobStatusResult"
+        m.server_streaming = True
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
@@ -255,6 +288,7 @@ APPLIED = [
     edit_issue6_scheduler_restart,
     edit_issue7_multitenant,
     edit_issue8_latency_tier,
+    edit_issue11_speculation,
 ]
 
 

@@ -408,6 +408,30 @@ def test_rpc_retries_unavailable_then_succeeds(monkeypatch):
     assert len(calls) == 3
 
 
+def test_rpc_retries_cancelled_goaway(monkeypatch):
+    """ISSUE 11 regression: a scheduler crash/restart stops its gRPC
+    server, which GOAWAYs in-flight unary calls as CANCELLED — the other
+    went-away shape, retried like UNAVAILABLE (this client never cancels
+    its own unary calls)."""
+    import grpc
+
+    class Boom(grpc.RpcError, _FakeGrpcError):
+        def __init__(self, code):
+            _FakeGrpcError.__init__(self, code)
+
+    calls = []
+
+    def stub(params):
+        calls.append(1)
+        if len(calls) < 2:
+            raise Boom(grpc.StatusCode.CANCELLED)
+        return pb.PollWorkResult()
+
+    c = _client_with_stub(stub)
+    assert c.poll_work(pb.PollWorkParams()) is not None
+    assert len(calls) == 2
+
+
 def test_rpc_does_not_retry_execution_errors():
     import grpc
 

@@ -561,6 +561,69 @@ def test_fuzz_float_extrema_minmax(tmp_path, seed):
                     assert a == b, (sql, name, a, b)
 
 
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_speculation_straggler(seed):
+    """Speculation fuzz slice (ISSUE 11 satellite): random 2-stage plans
+    through the REAL scheduler + executors under seeded `task.slow` chaos
+    with speculation ARMED (aggressive thresholds, predictions warmed by
+    the fault-free pass — the task.run op is job-independent, so the clean
+    run's durations predict the chaos run's). The straggler site never
+    corrupts work, and first-completion-wins must never double-count it:
+    results are BIT-IDENTICAL to the fault-free baseline whatever the
+    duplicate/primary race does. Own rng streams (20000+ data, 21000+
+    queries), so every baseline stream above stays byte-identical."""
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.ops.runtime import recovery_stats, speculation_stats
+
+    rng = np.random.default_rng(20000 + seed)
+    qrng = np.random.default_rng(21000 + seed)
+    _fresh()
+    costmodel.reset()
+    n = int(rng.integers(2_000, 8_000))
+    table = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+            "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+            "s": pa.array([f"t{x}" for x in rng.integers(0, 5, n)]),
+        }
+    )
+    queries = _distributed_fuzz_queries(qrng)
+    # the in-memory cost store (dir "") is process-global: the clean pass
+    # warms the task.run rates the chaos pass's straggler monitor predicts
+    # from — every config (cluster AND per-job) pins the same dir so no
+    # configure() rebind drops the store between the two passes
+    spec_cluster = BallistaConfig({
+        "ballista.tpu.cost_model_dir": "",
+        "ballista.speculation.min_runtime_ms": "100",
+        "ballista.speculation.multiplier": "2",
+    })
+    base_client = {
+        "ballista.shuffle.partitions": "4",
+        "ballista.cache.results": "false",
+        "ballista.tpu.cost_model_dir": "",
+    }
+    clean = _run_distributed(table, queries, base_client, spec_cluster)
+    chaos_client = {
+        **base_client,
+        "ballista.chaos.rate": "0.2",
+        "ballista.chaos.seed": str(90 + seed),
+        "ballista.chaos.sites": "task.slow",
+        "ballista.chaos.slow_ms": "2000",
+    }
+    recovery_stats(reset=True)
+    speculation_stats(reset=True)
+    chaotic = _run_distributed(table, queries, chaos_client, spec_cluster)
+    rec = recovery_stats(reset=True)
+    spec = speculation_stats(reset=True)
+    costmodel.reset()
+    for sql, c, t in zip(queries, clean, chaotic):
+        assert t.equals(c), (sql, t.to_pydict(), c.to_pydict())
+    assert rec.get("chaos_slow_injected", 0) > 0, rec
+    assert spec.get("launched", 0) >= 1, (spec, rec)
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_fuzz_routing(tmp_path, seed):
     """Adaptive-execution replay (ISSUE 10): the duplicate-key join sweep

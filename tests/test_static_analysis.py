@@ -184,6 +184,27 @@ def test_failure_rule_push_site_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_speculation_fixture_pair():
+    """ISSUE 11 satellite: speculation discipline — a minted duplicate
+    attempt (`.speculative = True`) with no same-scope durable ledger
+    record (_spec_put / _ledger_put) fails lint, as does the unregistered
+    straggler chaos site; the ledgered mint, the ledgered promotion, the
+    non-literal echo site, and the registered `task.slow` literal are
+    clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_spec_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any("ad-hoc speculative attempt" in m for m in findings), findings
+    assert any(
+        "unregistered chaos site" in m and "task.straggle" in m
+        for m in findings
+    ), findings
+    good = analyze_file(str(FIXTURES / "failure_spec_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_routing_rule_fixture_pair():
     """ISSUE 10 satellite: a decline-helper call with no routing
     observation in scope and no cold-path annotation fails lint — a
