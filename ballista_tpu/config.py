@@ -141,6 +141,19 @@ BALLISTA_SPECULATION_MULTIPLIER = "ballista.speculation.multiplier"
 # duplicate could help; this is also why fault-free runs launch nothing
 # under the defaults)
 BALLISTA_SPECULATION_MIN_RUNTIME_MS = "ballista.speculation.min_runtime_ms"
+# -- shared-scan multi-query execution (ISSUE 13) ---------------------------
+# scheduler-side scan sharing: concurrent DISTINCT jobs whose pending
+# fused-aggregate stages read the same persisted layout (same scan files,
+# same chunk cover) are grouped into one batched task — the executor runs
+# the group as ONE device launch over ONE resident upload, each member's
+# readback routed to its own job's shuffle piece, bit-identical to solo
+# execution. Evidence-gated through the cost model's `stage.batch` rates (a
+# batch predicted slower than the members' solo sum dispatches solo), and
+# any incompatibility at the executor degrades the member to solo, never to
+# a wrong answer.
+BALLISTA_SHARED_SCAN = "ballista.shared_scan"
+# most member tasks one batched dispatch may carry (the primary included)
+BALLISTA_SHARED_SCAN_MAX_BATCH = "ballista.shared_scan.max_batch"
 # client-side server-push job-status notifications (ISSUE 11 satellite): a
 # server-streaming SubscribeJobStatus RPC mirroring SubscribeWork replaces
 # the 5ms-floor adaptive status poll on the wait/stream paths; the poll
@@ -276,6 +289,11 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_SPECULATION_MULTIPLIER: "4",
     BALLISTA_SPECULATION_MIN_RUNTIME_MS: "500",
     BALLISTA_PUSH_STATUS: "true",
+    # shared-scan batching defaults ON: a batch is only formed from
+    # co-pending compatible stages, degrades to solo on any doubt, and is
+    # bit-identical to solo execution by construction
+    BALLISTA_SHARED_SCAN: "true",
+    BALLISTA_SHARED_SCAN_MAX_BATCH: "8",
 }
 
 
@@ -442,6 +460,15 @@ class BallistaConfig(Mapping[str, str]):
         return max(
             0.0, float(self._settings[BALLISTA_SPECULATION_MIN_RUNTIME_MS])
         ) / 1000.0
+
+    def shared_scan(self) -> bool:
+        """Shared-scan multi-query batching (ISSUE 13): concurrent jobs'
+        compatible fused-aggregate stages dispatch as one batched task."""
+        return self._settings[BALLISTA_SHARED_SCAN].lower() in ("1", "true", "yes")
+
+    def shared_scan_max_batch(self) -> int:
+        """Most member tasks per batched dispatch (minimum 2)."""
+        return max(2, int(self._settings[BALLISTA_SHARED_SCAN_MAX_BATCH]))
 
     def push_status(self) -> bool:
         """Client-side server-push job-status notifications (ISSUE 11)."""

@@ -282,11 +282,7 @@ class PollLoop:
                 self._finished.put(st)
             raise
         if result.HasField("task"):
-            pid = result.task.task_id
-            with self._inflight_mu:
-                self._inflight[(pid.job_id, pid.stage_id, pid.partition_id)] = (
-                    pid, result.task.attempt,
-                )
+            self._register_inflight(result.task)
             # slot ownership transfers to the task thread (released in
             # _run_task's finally). A task arriving WITHOUT a held slot
             # (scheduler ignored can_accept_task=False) must not be
@@ -347,6 +343,18 @@ class PollLoop:
             failures += 1
             self._stop.wait(backoff_delay(failures - 1, 0.05, cap=2.0))
 
+    def _register_inflight(self, task: pb.TaskDefinition) -> None:
+        """Track a received task — and every shared-scan batch sibling
+        riding it (ISSUE 13) — in the running echo BEFORE execution starts,
+        so the scheduler's orphaned-assignment grace never fires on a
+        member whose batch is still being set up."""
+        with self._inflight_mu:
+            for td in (task, *task.siblings):
+                pid = td.task_id
+                self._inflight[(pid.job_id, pid.stage_id, pid.partition_id)] = (
+                    pid, td.attempt,
+                )
+
     def _on_pushed_task(self, task: pb.TaskDefinition) -> None:
         """One pushed TaskDefinition: exactly the poll-receive path, minus
         the held slot — the task thread blocks for its semaphore slot
@@ -354,23 +362,22 @@ class PollLoop:
         overrun just queues on the semaphore, never drops work)."""
         from ballista_tpu.ops.runtime import record_serving
 
-        pid = task.task_id
-        with self._inflight_mu:
-            self._inflight[(pid.job_id, pid.stage_id, pid.partition_id)] = (
-                pid, task.attempt,
-            )
+        self._register_inflight(task)
         record_serving("task_pushed")
         threading.Thread(
             target=self._run_task, args=(task, False), daemon=True
         ).start()
 
-    def _run_task(self, task: pb.TaskDefinition, slot_held: bool = True) -> None:
-        from ballista_tpu.errors import ShuffleFetchError
-        from ballista_tpu.serde.physical import phys_plan_from_proto
-        from ballista_tpu.utils.chaos import chaos_from_config
+    def _member_setup(self, task: pb.TaskDefinition):
+        """Status skeleton + confined, deserialized plan + task context for
+        one member of a dispatch. Failures land in the member's OWN failed
+        status (plan None) — in a shared-scan batch (ISSUE 13) a bad member
+        must never take its siblings down. Returns (task, status, plan,
+        ctx)."""
+        import functools
 
-        if not slot_held:
-            self._available.acquire()
+        from ballista_tpu.serde.physical import phys_plan_from_proto
+
         pid = task.task_id
         status = pb.TaskStatus()
         status.partition_id.CopyFrom(pid)
@@ -403,10 +410,39 @@ class PollLoop:
                 cfg = BallistaConfig(
                     {**cfg.to_dict(), **{kv.key: kv.value for kv in task.settings}}
                 )
+            ctx = TaskContext(
+                config=cfg,
+                work_dir=self.work_dir,
+                job_id=pid.job_id,
+                # bind the merged config so fetch retries honor
+                # ballista.rpc.* (incl. per-job overrides)
+                shuffle_fetcher=functools.partial(
+                    flight_shuffle_fetcher, config=cfg
+                ),
+                attempt=task.attempt,
+            )
+            return task, status, plan, ctx
+        except Exception as e:
+            log.error("task %s setup failed: %s", pid, traceback.format_exc())
+            status.failed.error = f"{type(e).__name__}: {e}"
+            status.failed.executor_id = self.metadata.id
+            return task, status, None, None
+
+    def _member_execute(self, task, status, plan, ctx, shared=None) -> None:
+        """Execute one member's plan, filling its status in place. `shared`
+        carries a shared-scan batch's precomputed member tables (ISSUE 13);
+        the splice happens inside kernels.hash_aggregate."""
+        from ballista_tpu.errors import ShuffleFetchError
+        from ballista_tpu.utils.chaos import chaos_from_config
+
+        pid = task.task_id
+        try:
             # chaos from the MERGED config: per-job settings can arm the
             # "task.execute" site for just their job. Keyed on the attempt
-            # so a retried attempt draws a fresh deterministic verdict.
-            chaos = chaos_from_config(cfg)
+            # so a retried attempt draws a fresh deterministic verdict —
+            # and applied PER MEMBER, so a faulted member of a batch fails
+            # alone while its siblings complete.
+            chaos = chaos_from_config(ctx.config)
             if chaos is not None:
                 # keyed on plan coordinates + attempt, NOT the (random) job
                 # id: the same seed faults the same tasks every run
@@ -425,7 +461,7 @@ class PollLoop:
                     # FRESH verdict and is not slowed with its primary.
                     from ballista_tpu.ops.runtime import record_recovery
 
-                    delay = cfg.chaos_slow_ms() / 1000.0
+                    delay = ctx.config.chaos_slow_ms() / 1000.0
                     record_recovery("chaos_injected")
                     record_recovery("chaos_slow_injected")
                     log.warning(
@@ -434,19 +470,8 @@ class PollLoop:
                         pid.partition_id, task.attempt, delay * 1000,
                     )
                     time.sleep(delay)
-            import functools
-
-            ctx = TaskContext(
-                config=cfg,
-                work_dir=self.work_dir,
-                job_id=pid.job_id,
-                # bind the merged config so fetch retries honor
-                # ballista.rpc.* (incl. per-job overrides)
-                shuffle_fetcher=functools.partial(
-                    flight_shuffle_fetcher, config=cfg
-                ),
-                attempt=task.attempt,
-            )
+            if shared is not None:
+                ctx.shared_scan = shared
             stats = plan.execute_shuffle_write(pid.partition_id, ctx)
             base = os.path.join(
                 self.work_dir, pid.job_id, str(pid.stage_id), str(pid.partition_id)
@@ -479,16 +504,71 @@ class PollLoop:
             log.error("task %s failed: %s", pid, traceback.format_exc())
             status.failed.error = f"{type(e).__name__}: {e}"
             status.failed.executor_id = self.metadata.id
+
+    def _run_task(self, task: pb.TaskDefinition, slot_held: bool = True) -> None:
+        """Run one TaskDefinition — or a shared-scan batch group (ISSUE 13:
+        the primary plus task.siblings) under ONE task slot. Each member
+        gets its own status; a member failing at any point (setup, chaos,
+        execution) fails alone, and compatible members' fused-aggregate
+        stages are precomputed in one combined device launch over one
+        shared upload before the members' plans execute."""
+        if not slot_held:
+            self._available.acquire()
+        members = [task] + list(task.siblings)
+        prepped = []
+        reported = 0
+
+        def report(td: pb.TaskDefinition, status: pb.TaskStatus) -> None:
+            # enqueue the status BEFORE dropping from in-flight: a poll in
+            # the gap then reports the task as still running (harmless)
+            # instead of as vanished (which would look like an orphaned
+            # assignment). Per member, AS IT FINISHES — member 1's job
+            # completion must not wait out member 8's execution — and the
+            # wake kicks the poll loop out of any decayed idle wait so no
+            # status rides a multi-second heartbeat.
+            self._finished.put(status)
+            pid = td.task_id
+            with self._inflight_mu:
+                self._inflight.pop(
+                    (pid.job_id, pid.stage_id, pid.partition_id), None
+                )
+            self._wake.set()
+
+        try:
+            for td in members:
+                prepped.append(self._member_setup(td))
+            shared = None
+            if len(members) > 1:
+                from ballista_tpu.ops import sharedscan
+
+                try:
+                    shared = sharedscan.precompute(
+                        [
+                            (plan, td.task_id.partition_id, ctx)
+                            for td, _st, plan, ctx in prepped
+                            if plan is not None
+                        ],
+                        max_batch=len(members),
+                    )
+                except Exception:
+                    # the precompute is an accelerator: any failure means
+                    # every member simply executes solo below
+                    log.warning("shared-scan precompute failed; members "
+                                "run solo", exc_info=True)
+                    shared = None
+            for td, status, plan, ctx in prepped:
+                if plan is not None:
+                    self._member_execute(td, status, plan, ctx, shared)
+                report(td, status)
+                reported += 1
         finally:
             self._available.release()
-        # enqueue the status BEFORE dropping from in-flight: a poll in the
-        # gap then reports the task as still running (harmless) instead of
-        # as vanished (which would look like an orphaned assignment)
-        self._finished.put(status)
-        with self._inflight_mu:
-            self._inflight.pop(
-                (pid.job_id, pid.stage_id, pid.partition_id), None
-            )
-        # kick the poll loop out of any decayed idle wait: the status (and
-        # with it job completion) must not ride a multi-second heartbeat
-        self._wake.set()
+            # safety net: members never reached (an unexpected raise mid-
+            # loop) still report — as failures, never as phantom pendings
+            for td, status, _plan, _ctx in prepped[reported:]:
+                if status.WhichOneof("status") is None:
+                    status.failed.error = (
+                        "batched execution aborted before this member ran"
+                    )
+                    status.failed.executor_id = self.metadata.id
+                report(td, status)

@@ -21,7 +21,10 @@ the AOT stable stage key, so the store is keyed like the AOT cache on
 stable stage identity), and "task.run|<shape>" under engine "task" (units
 = 1; the SCHEDULER's per-stage task durations, keyed on the
 job-id-scrubbed stage plan shape via task_run_op below — the rates behind
-speculative-execution straggler detection). Entries carry the
+speculative-execution straggler detection), and "stage.batch" under engine
+"task" (units = member count; the SCHEDULER's wall durations of shared-scan
+batched tasks, ISSUE 13 — the evidence gate dispatches solo when a batch is
+predicted slower than the members' solo task.run sum). Entries carry the
 jax/jaxlib/backend
 fingerprint of the writer (ops/aotcache.py::fingerprint): a store written
 by a different stack is ignored wholesale — costs measured on another
@@ -393,6 +396,23 @@ def rate(op: str, engine: str = "device") -> Optional[Tuple[float, int]]:
     if n == 0 or units <= 0:
         return None
     return s / units, n
+
+
+def bucket_rate(op: str, units: float, engine: str = "device") -> Optional[float]:
+    """Seconds per unit of the EXACT power-of-two bucket covering `units`,
+    or None when the bucket is cold (< MIN_OBSERVATIONS) or the model is
+    off. Unlike predict(), never falls back to the op-global rate — the
+    h2d chunk picker (ops/runtime.py) compares candidate buckets against
+    each other, and the global fallback would make every candidate tie."""
+    if not _enabled:
+        return None
+    k = _key(op, engine, _bucket(units))
+    with _lock:
+        _load_locked()
+        e = _store.get(k)
+        if e is None or e["n"] < MIN_OBSERVATIONS or e["units"] <= 0:
+            return None
+        return e["s"] / e["units"]
 
 
 def predict(op: str, units: float, engine: str = "device") -> Optional[float]:

@@ -186,7 +186,18 @@ def _configure_jax_cache() -> None:
     _cache_configured = True
 
 
-def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
+def resolve_stage(exec_node, ctx) -> Tuple[object, str, str, float]:
+    """Build-or-fetch the fused device stage for one aggregate node WITHOUT
+    running it: the structural-cache half of hash_aggregate, factored out so
+    the shared-scan batch executor (ops/sharedscan.py, ISSUE 13) can resolve
+    member stages up front and group compatible ones into one launch.
+
+    Returns (stage, key, stable_key, unit_size): `stage` is False when the
+    shape permanently declined to the host path (cached verdict included),
+    `key` the full mtime-bearing cache key, `stable_key` the mtime-free
+    stage identity (the AOT/cost-store key half), and `unit_size` the
+    stage's input size in leaf-file bytes or memory-scan rows (the
+    stage.run cost-observation units)."""
     from ballista_tpu.ops.stage import FusedAggregateStage
 
     _configure_jax_cache()
@@ -198,15 +209,6 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
 
     aotcache.configure(ctx.config)
     costmodel.configure(ctx.config)
-    # COUNT-over-LEFT-join as device membership counting (q13): the
-    # per-probe counts plane replaces the join expansion entirely. A cheap
-    # shape prescreen — non-matching aggregates fall through to the ladder
-    if ctx.config.tpu_device_join():
-        from ballista_tpu.ops.countjoin import try_count_left_join
-
-        counted = try_count_left_join(exec_node, partition, ctx)
-        if counted is not None:
-            return counted
     # structural cache: identical plan shapes (the common case for repeated
     # queries) share one stage — and with it the jit trace/compile cache.
     # Memory scans carry no identity in their display: include source ids so
@@ -370,6 +372,44 @@ def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
                 _stage_cache[key] = built
                 _stage_cache_pins[key] = pinned
                 stage = built
+    return stage, key, stable, unit_size
+
+
+def hash_aggregate(exec_node, partition: int, ctx) -> Optional[pa.Table]:
+    # bind the AOT disk tier + cost model from THIS dispatch's config
+    # BEFORE any path that compiles or observes (the countjoin prescreen
+    # included — resolve_stage rebinds idempotently for the ladder below)
+    from ballista_tpu.ops import aotcache, costmodel
+
+    _configure_jax_cache()
+    aotcache.configure(ctx.config)
+    costmodel.configure(ctx.config)
+    # shared-scan splice (ISSUE 13): the batched-task executor already ran
+    # this node's partition inside one combined device launch — hand its
+    # table straight back. The precompute produced EXACTLY what stage.run
+    # below would (bit-identity is the batching invariant), so nothing
+    # downstream can tell. Checked before the countjoin prescreen on
+    # purpose: only scan-rooted stages (join-free row sources) are ever
+    # precomputed, and countjoin only matches join shapes, so the two can
+    # never claim the same node.
+    shared = getattr(ctx, "shared_scan", None)
+    if shared is not None:
+        hit = shared.take(exec_node, partition)
+        if hit is not None:
+            from ballista_tpu.ops.runtime import record_routing
+
+            record_routing("batch", "stage")
+            return hit
+    # COUNT-over-LEFT-join as device membership counting (q13): the
+    # per-probe counts plane replaces the join expansion entirely. A cheap
+    # shape prescreen — non-matching aggregates fall through to the ladder
+    if ctx.config.tpu_device_join():
+        from ballista_tpu.ops.countjoin import try_count_left_join
+
+        counted = try_count_left_join(exec_node, partition, ctx)
+        if counted is not None:
+            return counted
+    stage, key, stable, unit_size = resolve_stage(exec_node, ctx)
     if stage is False:
         return None
     try:

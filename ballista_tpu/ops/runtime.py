@@ -870,6 +870,35 @@ def speculation_stats(reset: bool = False) -> Dict[str, float]:
     return out
 
 
+# accumulated shared-scan events (ISSUE 13): scheduler-side batch formation
+# (batches_formed = batched dispatches minted, batched_stages = member tasks
+# riding them, batch_gate_solo = evidence-gate declines, batch_chaos_solo =
+# scheduler.batch-torn formations degraded to solo) and executor-side group
+# execution (shared_groups = groups that actually launched shared,
+# uploads_saved / launches_saved = per-batch member-transfers and
+# member-launches avoided vs solo, device_launches = combined launches run,
+# member_degraded / batch_degraded = members or whole groups that fell back
+# to solo execution — bit-identical either way). Same in-process accumulator
+# pattern as recovery/tenancy/serving above; bench.py reports a per-scenario
+# `shared_scan` block off this.
+_shared_scan_lock = threading.Lock()
+_shared_scan: Dict[str, int] = {}  # event -> count; guarded-by: _shared_scan_lock
+
+
+def record_shared_scan(event: str, n: int = 1) -> None:
+    with _shared_scan_lock:
+        _shared_scan[event] = _shared_scan.get(event, 0) + int(n)
+
+
+def shared_scan_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated shared-scan counters."""
+    with _shared_scan_lock:
+        out = dict(_shared_scan)
+        if reset:
+            _shared_scan.clear()
+    return out
+
+
 # accumulated adaptive-routing decisions (ISSUE 10): every engine choice
 # the cost-model-aware ladder makes — device / host / split — lands here
 # with its predicted-vs-observed cost when a prediction existed, plus named
@@ -887,6 +916,9 @@ _routing = {
     "observed_s": 0.0,
     "predictions": 0,
     "mispredicts": 0,
+    # last tuned h2d chunk size (ISSUE 13 satellite): a VALUE, not a count —
+    # what _h2d_chunk_bytes() chose for the most recent chunked upload
+    "h2d_chunk_bytes": 0,
 }
 
 
@@ -995,6 +1027,7 @@ def routing_stats(reset: bool = False) -> Dict[str, object]:
             "observed_s": _routing["observed_s"],
             "predictions": _routing["predictions"],
             "mispredicts": _routing["mispredicts"],
+            "h2d_chunk_bytes": _routing["h2d_chunk_bytes"],
         }
         if reset:
             _routing["engines"] = {}
@@ -1003,6 +1036,7 @@ def routing_stats(reset: bool = False) -> Dict[str, object]:
             _routing["observed_s"] = 0.0
             _routing["predictions"] = 0
             _routing["mispredicts"] = 0
+            _routing["h2d_chunk_bytes"] = 0
     out["mispredict_rate"] = (
         out["mispredicts"] / out["predictions"] if out["predictions"] else 0.0
     )
@@ -1019,19 +1053,48 @@ def routing_stats(reset: bool = False) -> Dict[str, object]:
 # store as the h2d observations (observe-only today, like readback: no
 # predictor consults the h2d rate yet).
 
-_H2D_CHUNK_BYTES = 64 << 20  # per-chunk transfer size
+_H2D_CHUNK_BYTES = 64 << 20  # static per-chunk default (cold store)
 _H2D_MIN_CHUNKED = 256 << 20  # arrays below this go as one piece
+# tuned-chunk candidates (ISSUE 13 satellite): the power-of-two bucket
+# sizes the picker compares against the cost store's observed per-chunk
+# h2d rates — 16 MB .. 256 MB around the static 64 MB default
+_H2D_CHUNK_CANDIDATES = tuple(1 << p for p in range(24, 29))
+
+
+def _h2d_chunk_bytes() -> int:
+    """Per-chunk h2d transfer size, tuned from the cost store (ISSUE 13
+    satellite, PR 10 residue): among the power-of-two candidates, pick the
+    bucket whose OBSERVED per-chunk h2d rate (seconds per byte, exact
+    bucket only — the op-global fallback rate would make every candidate
+    tie) is best; buckets without enough observations don't compete, and a
+    fully cold store keeps the static 64 MB default. Chunking never
+    changes the concatenated bytes, so the choice is bit-identical by
+    construction. The pick is surfaced as `h2d_chunk_bytes` in
+    routing_stats."""
+    from ballista_tpu.ops import costmodel
+
+    best, best_rate = _H2D_CHUNK_BYTES, None
+    for cand in _H2D_CHUNK_CANDIDATES:
+        r = costmodel.bucket_rate("h2d", cand)
+        if r is None:
+            continue
+        if best_rate is None or r < best_rate:
+            best, best_rate = cand, r
+    with _routing_lock:
+        _routing["h2d_chunk_bytes"] = best
+    return best
 
 
 def upload_array(arr: np.ndarray):
     """Host->device transfer of one numpy array. Arrays past
-    _H2D_MIN_CHUNKED split along axis 0 into _H2D_CHUNK_BYTES chunks,
-    double-buffered (dispatch chunk j, then block on chunk j-1 and record
-    its h2d cost), and concatenate on device — bit-identical to the single
-    put, with a transient 2x HBM peak for this one array. Small arrays —
-    and every array while the cost model is off (the chunked path's extra
-    device copy and HBM peak are part of the adaptive tier, and its
-    observations would be discarded anyway) — keep the plain async
+    _H2D_MIN_CHUNKED split along axis 0 into _h2d_chunk_bytes() chunks
+    (the cost store's observed h2d rates pick the chunk size; 64 MB when
+    cold), double-buffered (dispatch chunk j, then block on chunk j-1 and
+    record its h2d cost), and concatenate on device — bit-identical to the
+    single put, with a transient 2x HBM peak for this one array. Small
+    arrays — and every array while the cost model is off (the chunked
+    path's extra device copy and HBM peak are part of the adaptive tier,
+    and its observations would be discarded anyway) — keep the plain async
     jnp.asarray dispatch."""
     import jax.numpy as jnp
 
@@ -1042,7 +1105,7 @@ def upload_array(arr: np.ndarray):
     if not costmodel.enabled() or nbytes < _H2D_MIN_CHUNKED or rows < 2:
         return jnp.asarray(arr)
     row_bytes = max(1, nbytes // rows)
-    chunk_rows = max(1, _H2D_CHUNK_BYTES // row_bytes)
+    chunk_rows = max(1, _h2d_chunk_bytes() // row_bytes)
     if chunk_rows >= rows:
         return jnp.asarray(arr)
     chunks = []

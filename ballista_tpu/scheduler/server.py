@@ -637,6 +637,21 @@ class SchedulerServer:
             sub.outstanding.add(
                 (pid.job_id, pid.stage_id, pid.partition_id, status.attempt)
             )
+            if not speculative:
+                # scan-sharing pass (ISSUE 13): ride co-pending compatible
+                # stages of OTHER jobs on this dispatch as batch siblings —
+                # each holds its own push credit, resolved by its own
+                # terminal status like any pushed task
+                for st2, plan2 in self.state.form_shared_batch(
+                    status, plan, sub.executor_id
+                ):
+                    td.siblings.add().CopyFrom(
+                        self._task_definition(st2, plan2)
+                    )
+                    p2 = st2.partition_id
+                    sub.outstanding.add(
+                        (p2.job_id, p2.stage_id, p2.partition_id, st2.attempt)
+                    )
             sub.queue.put(td)
             record_serving("dispatch_push")
             pushed += 1
@@ -773,6 +788,15 @@ class SchedulerServer:
                     status, plan = assigned
                     result.task.CopyFrom(self._task_definition(status, plan))
                     result.task.speculative = speculative
+                    if not speculative:
+                        # scan-sharing pass (ISSUE 13): batch co-pending
+                        # compatible stages of other jobs onto this reply
+                        for st2, plan2 in self.state.form_shared_batch(
+                            status, plan, request.metadata.id
+                        ):
+                            result.task.siblings.add().CopyFrom(
+                                self._task_definition(st2, plan2)
+                            )
                     record_serving("dispatch_poll")
             for job_id in jobs:
                 self.state.synchronize_job_status(job_id)

@@ -73,6 +73,19 @@ def _record_speculation(event: str, n: float = 1) -> None:
     record_speculation(event, n)
 
 
+def _record_shared_scan(event: str, n: int = 1) -> None:
+    from ballista_tpu.ops.runtime import record_shared_scan
+
+    record_shared_scan(event, n)
+
+
+def _record_routing(engine: str, op: str = "", predicted_s=None,
+                    observed_s=None) -> None:
+    from ballista_tpu.ops.runtime import record_routing
+
+    record_routing(engine, op, predicted_s, observed_s)
+
+
 def _attempts_error(t: pb.TaskStatus) -> str:
     """Human-readable failure naming EVERY attempt of the task — the error
     a job fails with once retries are exhausted."""
@@ -326,6 +339,34 @@ class SchedulerState:
         self._speculative: Dict[
             Tuple[str, int, int], Tuple[str, int, float, bool, bool]
         ] = {}
+        # elapsed-ordered straggler heap (ISSUE 13 satellite, PR 11
+        # residue): (monotonic start, key3) entries mirroring
+        # _running_since, so the straggler monitor scans ONLY tasks past
+        # the speculation floor instead of every running task under the
+        # global KV lock on each idle slot. Lazily invalidated — an entry
+        # whose start time no longer matches the watch map is a superseded
+        # attempt and drops on sight. Access under the global KV lock like
+        # _running_since.
+        self._running_heap: List[Tuple[float, Tuple[str, int, int]]] = []
+        # -- shared-scan batching (ISSUE 13) --------------------------------
+        self._shared_scan = self.config.shared_scan()
+        self._shared_max_batch = self.config.shared_scan_max_batch()
+        # scheduler.batch chaos rotation (like _admit_seq): a torn batch
+        # formation degrades THAT dispatch to solo; the next formation
+        # draws a fresh deterministic verdict
+        self._batch_seq = 0  # under the kv lock (dispatch paths)
+        # batched-task accounting: member key3 -> batch id, and batch id ->
+        # {k, t0, remaining, predicted, dirty}. In-memory only (pure
+        # cost-model learning; a restarted scheduler just re-learns), all
+        # access under the global KV lock.
+        self._batch_members: Dict[Tuple[str, int, int], int] = {}
+        self._batches: Dict[int, dict] = {}
+        self._batch_next_id = 0
+        # (job, stage) -> scan-sharing signature (or None): stage plans are
+        # immutable once planned, so the signature is computed once — the
+        # candidate scan must not re-deserialize every co-pending stage
+        # plan on every dispatch. Bounded like _task_op_cache.
+        self._shared_sig_cache: Dict[Tuple[str, int], Optional[tuple]] = {}
         # per-(job, stage) cache of the job-independent task.run cost op
         self._task_op_cache: Dict[Tuple[str, int], str] = {}
         # scheduler-owned task.run rates (op -> (total seconds, n)): the
@@ -813,9 +854,15 @@ class SchedulerState:
                 or cur[0] != status.running.executor_id
                 or cur[1] != status.attempt
             ):
+                import heapq
+
+                t0 = time.monotonic()
                 self._running_since[key3] = (
-                    status.running.executor_id, status.attempt, time.monotonic(),
+                    status.running.executor_id, status.attempt, t0,
                 )
+                # elapsed-ordered straggler heap: superseded entries for
+                # the same key invalidate lazily (start-time mismatch)
+                heapq.heappush(self._running_heap, (t0, key3))
         else:
             self._running_since.pop(key3, None)
         self.kv.put(key, status.SerializeToString())
@@ -925,9 +972,15 @@ class SchedulerState:
         if w == "completed":
             # observe the attempt's duration under the stage's
             # job-independent task.run op — the rates the straggler monitor
-            # predicts from (sibling completions warm it within one job)
+            # predicts from (sibling completions warm it within one job).
+            # A shared-scan batch member (ISSUE 13) instead folds into its
+            # batch's stage.batch observation: its own wall time IS the
+            # batch's wall time and would corrupt the solo rates the
+            # evidence gate compares against.
+            batched = key3 in self._batch_members
+            self._note_batch_member_done(key3, clean=True)
             rs = self._running_since.get(key3)
-            if rs is not None and rs[1] == status.attempt:
+            if not batched and rs is not None and rs[1] == status.attempt:
                 self._observe_task_run(
                     pid.job_id, pid.stage_id, time.monotonic() - rs[2]
                 )
@@ -1030,6 +1083,10 @@ class SchedulerState:
         doomed attempt."""
         pid0 = t.partition_id
         key3 = (pid0.job_id, pid0.stage_id, pid0.partition_id)
+        # a batched member leaving its attempt (failure, loss, lineage
+        # reset) dirties its batch accounting: a partial batch's wall time
+        # is not a clean stage.batch observation (ISSUE 13)
+        self._note_batch_member_done(key3, clean=False)
         spec = self._speculative.get(key3)
         if (
             promote
@@ -1059,7 +1116,13 @@ class SchedulerState:
             # status just re-stamped it with now) or its completion would
             # observe an understated duration into the task.run rates and
             # teach the monitor to over-speculate on this shape
+            import heapq
+
             self._running_since[key3] = (spec[0], spec[1], spec[2])
+            # re-stamping orphans the heap entry save_task_status just
+            # pushed (start-time mismatch); push the honest clock so the
+            # promoted attempt stays visible to the straggler monitor
+            heapq.heappush(self._running_heap, (spec[2], key3))
             # the promoted attempt enters the normal assignment ledger:
             # its owner's next echo vouches for it, and a restart re-adopts
             # it like any in-flight assignment
@@ -1238,6 +1301,9 @@ class SchedulerState:
         for key in list(self._speculative):
             if job_finished(key[0]):
                 self._spec_del(key)
+        for key in list(self._batch_members):
+            if job_finished(key[0]):
+                self._note_batch_member_done(key, clean=False)
         return reset
 
     def handle_fetch_failed(self, t: pb.TaskStatus, limit: int) -> bool:
@@ -1438,6 +1504,331 @@ class SchedulerState:
             return local[0] / local[1]
         return costmodel.predict(op, 1.0, engine="task")
 
+    def _straggler_candidates(
+        self, now: float
+    ) -> List[Tuple[str, int, int]]:
+        """Running-task keys past the speculation floor, MOST-ELAPSED
+        first, from the elapsed-ordered heap (ISSUE 13 satellite, PR 11
+        residue: the monitor used to linearly scan EVERY running task under
+        the global KV lock on each idle slot). The watch map is the
+        authority: a heap entry for a resolved task drops on sight, and an
+        entry whose start time disagrees with the map (superseded attempt,
+        or a re-stamped clock) RECONCILES in place — replaced with the
+        map's time so it re-sorts correctly. Because the heap orders by
+        start time, the walk stops at the first entry younger than the
+        floor — the common idle-slot case (every running task young) does
+        O(1) work instead of a 10k-entry sweep. Floor-passing entries pop
+        and re-push, so the heap stays consistent for the next slot.
+
+        INVARIANT the early exit relies on: every watch-map entry has at
+        least one heap entry carrying its EXACT clock — save_task_status
+        pushes at stamp time and the promotion re-stamp pushes the
+        corrected clock, so code that rewrites a watch clock directly must
+        push the corrected entry too (the reconcile above only repairs
+        entries the walk reaches before the break).
+        tests/test_speculation.py asserts the heap and a linear scan
+        agree."""
+        import heapq
+
+        heap = self._running_heap
+        if len(heap) > 4 * len(self._running_since) + 64:
+            # compact: superseded-attempt entries accumulate on busy
+            # schedulers; rebuild from the authoritative watch map
+            heap = self._running_heap = [
+                (e[2], k) for k, e in self._running_since.items()
+            ]
+            heapq.heapify(heap)
+        out: List[Tuple[str, int, int]] = []
+        seen: set = set()
+        popped: List[Tuple[float, Tuple[str, int, int]]] = []
+        while heap:
+            t0, key = heap[0]
+            cur = self._running_since.get(key)
+            if cur is None or key in seen:
+                heapq.heappop(heap)  # resolved, or a duplicate entry
+                continue
+            if cur[2] != t0:
+                # reconcile to the authoritative clock and re-sort
+                heapq.heapreplace(heap, (cur[2], key))
+                continue
+            if now - t0 < self._spec_floor_s:
+                break  # t0-ordered: everything below is younger still
+            heapq.heappop(heap)
+            seen.add(key)
+            popped.append((t0, key))
+            out.append(key)
+        for item in popped:
+            heapq.heappush(heap, item)
+        return out
+
+    # -- shared-scan batching (ISSUE 13) ------------------------------------
+    def _note_batch_member_done(self, key3: Tuple[str, int, int],
+                                clean: bool) -> None:
+        """Fold one member's outcome into its batch's accounting. When the
+        LAST member completes cleanly, the batch's wall duration lands in
+        the cost store as a `stage.batch` observation (units = member
+        count) and the decision is recorded against the formation-time
+        prediction — the evidence form_shared_batch's gate consults. A
+        member failing or requeueing dirties the batch: a partial batch's
+        wall time is not a batch cost."""
+        bid = self._batch_members.pop(key3, None)
+        if bid is None:
+            return
+        b = self._batches.get(bid)
+        if b is None:
+            return
+        b["remaining"].discard(key3)
+        if not clean:
+            b["dirty"] = True
+        if b["remaining"]:
+            return
+        del self._batches[bid]
+        if b["dirty"]:
+            _record_routing("batch", "stage.batch")
+            return
+        wall = time.monotonic() - b["t0"]
+        from ballista_tpu.ops import costmodel
+
+        costmodel.observe("stage.batch", float(b["k"]), wall, engine="task")
+        _record_routing("batch", "stage.batch", b["predicted"], wall)
+
+    def _shared_scan_signature(self, plan) -> Optional[tuple]:
+        """Cheap scan-sharing signature of one bound stage plan: non-None
+        for a fused-aggregate-shaped stage over a file-backed scan, keyed
+        on (scan type, file list, merge coverage, scan partition count) —
+        two stages with equal signatures dispatched for the same partition
+        read the same rows. A HEURISTIC only: the executor re-derives
+        compatibility authoritatively (mtimes, dtypes, dictionaries,
+        cardinality) and degrades mismatches to solo execution, so a false
+        positive here costs a little batching overhead, never a wrong
+        answer."""
+        from ballista_tpu.ops.sharedscan import _find_aggregate
+        from ballista_tpu.physical.basic import (
+            CoalesceBatchesExec,
+            FilterExec,
+            MergeExec,
+            ProjectionExec,
+        )
+        from ballista_tpu.physical.scan import CsvScanExec, ParquetScanExec
+
+        # the ONE spine walk (ops/sharedscan.py): the executor's
+        # authoritative compatibility check and this heuristic must find
+        # the same aggregate or batches silently stop grouping
+        node = _find_aggregate(plan)
+        if node is None:
+            return None
+        n = node.input
+        merged = False
+        while isinstance(n, (FilterExec, ProjectionExec, CoalesceBatchesExec,
+                             MergeExec)):
+            merged = merged or isinstance(n, MergeExec)
+            n = n.input
+        if not isinstance(n, (ParquetScanExec, CsvScanExec)):
+            return None
+        files = tuple(getattr(n.source, "files", ()) or ())
+        if not files:
+            return None
+        return (
+            type(n).__name__, files, merged,
+            n.output_partitioning().partition_count(),
+        )
+
+    def _cached_stage_signature(self, job_id: str, stage_id: int):
+        """Scan-sharing signature of a PLANNED stage, computed once per
+        (job, stage) from the stored stage plan — leaf fused-aggregate
+        stages read no shuffles, so the raw plan and the bound plan carry
+        the same signature. None = not batchable (cached too)."""
+        k = (job_id, stage_id)
+        if k in self._shared_sig_cache:
+            return self._shared_sig_cache[k]
+        try:
+            plan = self.get_stage_plan(job_id, stage_id)
+            sig = None if plan is None else self._shared_scan_signature(plan)
+        except Exception:
+            sig = None
+        if len(self._shared_sig_cache) > 10_000:
+            self._shared_sig_cache.clear()
+        self._shared_sig_cache[k] = sig
+        return sig
+
+    def form_shared_batch(
+        self, primary: pb.TaskStatus, plan, executor_id: str
+    ) -> List[Tuple[pb.TaskStatus, object]]:
+        """Scan-sharing pass (ISSUE 13): after `primary` was assigned, pull
+        OTHER jobs' co-pending compatible stage tasks for the SAME
+        partition into one batched dispatch. Each sibling flips to Running
+        through the exact assignment machinery (status write, durable
+        ledger entry, tenant accounting), so every recovery path — orphan
+        reconciliation, lease expiry, scheduler restart — sees N ordinary
+        in-flight tasks. Returns the (status, bound plan) siblings to ride
+        the primary's TaskDefinition; [] dispatches solo.
+
+        Evidence gate: with warm `stage.batch` rates AND solo task.run
+        predictions for every member, a batch predicted no faster than the
+        members' solo sum dispatches solo (recorded, never silent). Cold
+        models batch optimistically — the batch is bit-identical to solo
+        by construction, so the only risk is time, which the observation
+        then measures. The `scheduler.batch` chaos site tears formation
+        BEFORE any sibling is flipped: a torn formation degrades to solo
+        dispatch with nothing written. Never raises; any failure degrades
+        to solo."""
+        from ballista_tpu.utils.chaos import ChaosInjected
+
+        if not self._shared_scan:
+            return []
+        pid = primary.partition_id
+        sig = self._cached_stage_signature(pid.job_id, pid.stage_id)
+        if sig is None:
+            return []
+        partition = pid.partition_id
+        idx = self._ensure_task_index()
+        if len(self._batch_members) > 100_000:
+            # safety valve for a leak (normal resolution + the finished-job
+            # prune keep this at the in-flight batched count). Clearing
+            # mid-flight members means their completions observe their
+            # batch wall time into the SOLO task.run rates — a one-time
+            # pollution the store's forgetting/retier self-heals — so the
+            # bound sits far above any real in-flight population and the
+            # drop is counted, never silent.
+            _record_routing("batch", "stage.batch.accounting_dropped")
+            log.warning(
+                "shared-scan batch accounting overflowed (%d members); "
+                "dropped — solo task.run rates may be briefly polluted",
+                len(self._batch_members),
+            )
+            self._batch_members.clear()
+            self._batches.clear()
+        job_live: Dict[str, bool] = {}
+        inflight = (
+            self._tenant_inflight(idx) if self._tenant_quota > 0 else None
+        )
+        alive_others = {
+            m.id for m in self.get_executors_metadata()
+        } - {executor_id}
+        candidates: List[Tuple[str, int, object]] = []
+        for (job_id, stage_id), parts in list(idx.pending.items()):
+            if len(candidates) >= self._shared_max_batch - 1:
+                break
+            if job_id == pid.job_id or partition not in parts:
+                continue
+            if job_id not in job_live:
+                js = self.get_job_metadata(job_id)
+                job_live[job_id] = js is not None and js.WhichOneof(
+                    "status"
+                ) == "running"
+            if not job_live[job_id]:
+                continue
+            if inflight is not None:
+                # a batched sibling bypasses the fair-share visit order;
+                # it must still respect its tenant's in-flight quota —
+                # counting the candidates THIS batch is about to claim
+                # (a stale snapshot would admit a whole batch past the
+                # bound)
+                tenant = self.job_tenant(job_id)[0]
+                if inflight.get(tenant, 0) >= self._tenant_quota:
+                    continue
+                inflight[tenant] = inflight.get(tenant, 0) + 1
+            # cheap screen first: the cached per-(job, stage) signature —
+            # only a MATCH pays the plan bind (which the dispatched
+            # sibling TaskDefinition needs anyway)
+            if self._cached_stage_signature(job_id, stage_id) != sig:
+                continue
+            try:
+                bound = self._bound_stage_plan(job_id, stage_id, idx)
+                if bound is None:
+                    continue
+            except Exception:
+                continue
+            candidates.append((job_id, stage_id, bound))
+        if not candidates:
+            return []
+        # evidence gate (cost model, ISSUE 13): predicted batch wall vs the
+        # members' predicted solo sum — both under engine "task" beside the
+        # straggler monitor's rates
+        from ballista_tpu.ops import costmodel
+
+        k = len(candidates) + 1
+        predicted = costmodel.predict("stage.batch", float(k), engine="task")
+        solo = [self._predict_task_run(pid.job_id, pid.stage_id)] + [
+            self._predict_task_run(j, s) for j, s, _b in candidates
+        ]
+        if predicted is not None and all(s is not None for s in solo):
+            if predicted >= sum(solo):
+                _record_shared_scan("batch_gate_solo")
+                _record_routing("solo", "stage.batch")
+                log.info(
+                    "shared-scan gate: batch of %d predicted %.4fs >= solo "
+                    "sum %.4fs; dispatching solo", k, predicted, sum(solo),
+                )
+                return []
+        if self._chaos is not None:
+            self._batch_seq += 1
+            try:
+                self._chaos.maybe_fail(
+                    "scheduler.batch",
+                    f"g{self.generation}/batch{self._batch_seq}",
+                )
+            except ChaosInjected:
+                # torn BEFORE any write: the primary dispatches solo and
+                # the would-be siblings stay pending for the next slot
+                _record_shared_scan("batch_chaos_solo")
+                log.warning(
+                    "chaos[scheduler.batch]: batch formation torn; "
+                    "dispatching %s/%s/%s solo",
+                    pid.job_id, pid.stage_id, partition,
+                )
+                return []
+        out: List[Tuple[pb.TaskStatus, object]] = []
+        keys = [(pid.job_id, pid.stage_id, partition)]
+        for job_id, stage_id, bound in candidates:
+            # re-verify from the KV before claiming, exactly like
+            # assignment (the index is local; a peer may have moved on)
+            current = self.get_task_status(job_id, stage_id, partition)
+            if current is None or current.WhichOneof("status") is not None:
+                if current is not None:
+                    idx.observe(current)
+                continue
+            if (
+                current.history
+                and current.history[-1].executor_id == executor_id
+                and alive_others
+            ):
+                continue  # blacklist: this executor failed its last attempt
+            running = pb.TaskStatus()
+            running.CopyFrom(current)  # keep attempt + history
+            running.running.executor_id = executor_id
+            self.save_task_status(running)
+            self._ledger_put(
+                (job_id, stage_id, partition), executor_id, running.attempt
+            )
+            self.note_tenant_assigned(self.job_tenant(job_id)[0])
+            keys.append((job_id, stage_id, partition))
+            out.append((running, bound))
+        if not out:
+            return []
+        bid = self._batch_next_id
+        self._batch_next_id += 1
+        k = len(out) + 1
+        self._batches[bid] = {
+            "k": k,
+            "t0": time.monotonic(),
+            "remaining": set(keys),
+            "predicted": costmodel.predict(
+                "stage.batch", float(k), engine="task"
+            ),
+            "dirty": False,
+        }
+        for key in keys:
+            self._batch_members[key] = bid
+        _record_shared_scan("batches_formed")
+        _record_shared_scan("batched_stages", k)
+        log.info(
+            "shared-scan batch %d: %d stages over one scan -> %s "
+            "(primary %s/%s/%s)", bid, k, executor_id,
+            pid.job_id, pid.stage_id, partition,
+        )
+        return out
+
     def maybe_speculate(
         self, executor_id: str
     ) -> Optional[Tuple[pb.TaskStatus, object]]:
@@ -1468,12 +1859,22 @@ class SchedulerState:
                     _record_speculation("executor_lost")
         job_live: Dict[str, bool] = {}
         inflight: Optional[Dict[str, int]] = None
-        for key3, (owner, attempt, t0) in sorted(self._running_since.items()):
+        for key3 in self._straggler_candidates(now):
+            entry = self._running_since.get(key3)
+            if entry is None:
+                continue
+            owner, attempt, t0 = entry
             if key3 in self._speculative or owner == executor_id:
                 continue
-            elapsed = now - t0
-            if elapsed < self._spec_floor_s:
+            if key3 in self._batch_members:
+                # a shared-scan batch member (ISSUE 13) is co-scheduled
+                # with its siblings: its wall time is the BATCH's, not a
+                # straggler signal against its solo task.run rate —
+                # duplicating it would re-run work the batch is already
+                # finishing (real batch loss is covered by the normal
+                # lease/orphan machinery)
                 continue
+            elapsed = now - t0
             pred = self._predict_task_run(key3[0], key3[1])
             if pred is None or elapsed <= self._spec_multiplier * max(pred, 1e-6):
                 continue

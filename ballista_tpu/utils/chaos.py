@@ -63,6 +63,15 @@ SITES = (
                              # — a torn load is recorded with a reason and
                              # falls back to a fresh trace/compile, like a
                              # corrupted or version-mismatched artifact
+    "scheduler.batch",       # shared-scan batch formation (ISSUE 13,
+                             # scheduler/state.py form_shared_batch): tears
+                             # the grouping BEFORE any sibling's Running
+                             # flip is written, so the primary dispatches
+                             # SOLO — a degraded (unbatched) dispatch, never
+                             # a torn one. Results are bit-identical by
+                             # construction; keyed on a generation-rotated
+                             # per-process sequence so a restarted scheduler
+                             # draws fresh verdicts.
     "task.slow",             # deterministic straggler injection (ISSUE 11,
                              # execution_loop.py): a task whose (stage,
                              # partition, attempt) coordinate draws a slow

@@ -166,6 +166,39 @@ print("speculation smoke OK:",
        "counters": on["speculation"]})
 PY
 
+# strict gate on shared-scan multi-query execution (ISSUE 13): batched
+# dispatch bit-identical to solo on the same backend (evidence gate on/off,
+# mixed compatible/incompatible groups, scheduler.batch chaos, one member's
+# failure sparing its siblings, a mid-batch executor death, and the
+# concurrent-distinct-queries fuzz slice), plus the straggler heap and the
+# tuned h2d chunk size riding the same tier via their own suites above.
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_shared_scan.py
+
+# shared-scan bench smoke (ISSUE 13): concurrent distinct aggregate queries
+# over one table on a saturated single-slot cluster — batches must form,
+# at least one member upload must be SAVED by the shared scan, and every
+# batched result must be bit-identical to the never-batched reference.
+JAX_PLATFORMS=cpu BENCH_SHAREDSCAN_ONLY=1 BENCH_SS_DURATION=6 \
+    BENCH_SS_TENANTS=1,4 python bench.py > /tmp/_ballista_ss_smoke.json
+python - /tmp/_ballista_ss_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["shared_scan"]
+assert rec is not None, "shared-scan scenario returned no record"
+assert rec["bit_identical"], "shared-scan batching changed results"
+by = {r["tenants"]: r for r in rec["sweep"]}
+assert 4 in by, rec
+ss = by[4]["shared_scan"]
+assert ss.get("batches_formed", 0) >= 1, rec
+assert ss.get("batched_stages", 0) >= 2, rec
+assert ss.get("uploads_saved", 0) >= 1, rec
+# solo tenants must never batch
+assert by.get(1, {}).get("shared_scan", {}) == {}, rec
+print("shared-scan smoke OK:",
+      {"qps": {t: r["qps"] for t, r in by.items()},
+       "counters": ss})
+PY
+
 # latency harness smoke (ISSUE 8): tiny QPS, 2s budget per level — the
 # p50/p99 + time-to-first-batch + dispatch/compile-counter pipeline is
 # exercised end-to-end on CPU images even though the absolute numbers only

@@ -281,6 +281,27 @@ def edit_issue11_speculation(fdp) -> None:
         m.server_streaming = True
 
 
+def edit_issue13_shared_scan(fdp) -> None:
+    """ISSUE 13: shared-scan multi-query execution.
+
+    Adds (wire-compatible field addition):
+    - TaskDefinition.siblings: the OTHER member tasks of a shared-scan
+      batch group, each a full TaskDefinition (own task_id / attempt /
+      plan / settings). A batched dispatch carries the primary member in
+      the outer message plus its siblings here; the executor runs the
+      group as one shared-scan device launch and reports one TaskStatus
+      per member, so every existing status/ledger/recovery path sees N
+      independent tasks. Solo dispatches leave the field empty — an
+      executor that ignored it would simply never receive batches (the
+      scheduler only batches what one TaskDefinition can carry).
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+    add_field(
+        msgs["TaskDefinition"], "siblings", 6, MSG,
+        label=REP, type_name=".ballista.TaskDefinition",
+    )
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
@@ -289,6 +310,7 @@ APPLIED = [
     edit_issue7_multitenant,
     edit_issue8_latency_tier,
     edit_issue11_speculation,
+    edit_issue13_shared_scan,
 ]
 
 

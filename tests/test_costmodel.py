@@ -531,6 +531,41 @@ def test_upload_array_chunked_bit_identity(cm, monkeypatch):
     assert not routing_stats(reset=True)["events"].get("h2d_chunked")
 
 
+def test_h2d_chunk_size_tuned_from_observed_rates(cm, monkeypatch):
+    """ISSUE 13 satellite (PR 10 residue): the per-chunk h2d transfer size
+    follows the cost store's observed per-bucket rates — the best warm
+    bucket wins, a cold store keeps the static default — and the pick is
+    surfaced as h2d_chunk_bytes in routing stats. Bit-identical by
+    construction (chunking never changes the concatenated bytes)."""
+    import jax.numpy as jnp
+
+    from ballista_tpu.ops import runtime
+
+    monkeypatch.setattr(runtime, "_H2D_MIN_CHUNKED", 1 << 12)
+    monkeypatch.setattr(runtime, "_H2D_CHUNK_BYTES", 1 << 10)
+    monkeypatch.setattr(runtime, "_H2D_CHUNK_CANDIDATES", (1 << 9, 1 << 11))
+    # cold store: the static default stands
+    assert runtime._h2d_chunk_bytes() == 1 << 10
+    # warm rates: the 2 KiB bucket observed much faster per byte
+    costmodel.seed("h2d", float(1 << 9), 1.0)
+    costmodel.seed("h2d", float(1 << 11), 0.1)
+    assert runtime._h2d_chunk_bytes() == 1 << 11
+    arr = np.arange(8192, dtype=np.int64).reshape(1024, 8)
+    routing_stats(reset=True)
+    up = runtime.upload_array(arr)
+    np.testing.assert_array_equal(np.asarray(up), np.asarray(jnp.asarray(arr)))
+    rs = routing_stats(reset=True)
+    assert rs["events"].get("h2d_chunked") == 1
+    assert rs["h2d_chunk_bytes"] == 1 << 11
+    # flipping the observed rates flips the pick
+    costmodel.seed("h2d", float(1 << 9), 0.001)
+    assert runtime._h2d_chunk_bytes() == 1 << 9
+    # a bucket below MIN_OBSERVATIONS never competes, however fast it looks
+    costmodel.seed("h2d", float(1 << 9), 1000.0)        # warm but terrible
+    costmodel.seed("h2d", float(1 << 11), 0.0001, n=1)  # fast but unproven
+    assert runtime._h2d_chunk_bytes() == 1 << 9
+
+
 # -- AOT disk tier for the device-join programs (PR 8 residue) ---------------
 
 def test_join_programs_aot_disk_tier(tmp_path):

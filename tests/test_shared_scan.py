@@ -1,0 +1,456 @@
+"""Shared-scan multi-query execution (ISSUE 13): one upload, one launch,
+N queries.
+
+The invariant under test everywhere: a batched execution is BIT-IDENTICAL
+to solo execution for every member query — same backend, same to_pylist —
+whatever the batch composition, the evidence gate's verdict, chaos at the
+formation site, or a member's (or executor's) mid-batch death. Counters
+prove the sharing actually happened (batches_formed / batched_stages /
+uploads_saved / launches_saved), and every decline is visible, never
+silent.
+
+Determinism harness: clusters start with ZERO executors, the distinct
+queries are submitted concurrently and PLAN while nothing can pull work,
+then one executor starts — so every compatible stage task is co-pending at
+first dispatch and batch formation is deterministic rather than a race.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ballista_tpu.client import BallistaContext
+from ballista_tpu.config import BallistaConfig
+from ballista_tpu.executor.runtime import BallistaExecutor, StandaloneCluster
+from ballista_tpu.ops import costmodel
+from ballista_tpu.ops.runtime import (
+    recovery_stats,
+    routing_stats,
+    shared_scan_stats,
+)
+from ballista_tpu.utils.chaos import ChaosInjector
+
+QUERIES = [
+    "select g, sum(v) as s, count(*) as c from t group by g order by g",
+    "select g, min(q) as mn, max(q) as mx from t where v > 0 "
+    "group by g order by g",
+    "select g, sum(q) as sq from t where q < 30 group by g order by g",
+]
+# device column `s` is a STRING filter input: its stage grows a per-stage
+# dictionary, so it must never join a shared upload (string GROUP keys —
+# `g` above — stay host-side and batch fine)
+STRING_FILTER_QUERY = (
+    "select g, count(*) as c from t where s <> 'x1' group by g order by g"
+)
+
+
+@pytest.fixture(scope="module")
+def table_path(tmp_path_factory):
+    rng = np.random.default_rng(42)
+    n = 40_000
+    t = pa.table({
+        "g": pa.array([f"k{v}" for v in rng.integers(0, 6, n)]),
+        "s": pa.array([f"x{v}" for v in rng.integers(0, 4, n)]),
+        "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+        "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+        "d": pa.array(
+            rng.integers(8000, 12000, n), type=pa.int32()
+        ).cast(pa.date32()),
+    })
+    path = str(tmp_path_factory.mktemp("sharedscan") / "t.parquet")
+    pq.write_table(t, path)
+    return path
+
+
+def _client_settings(**over):
+    base = {
+        "ballista.executor.backend": "tpu",
+        "ballista.cache.results": "false",
+        "ballista.shuffle.partitions": "2",
+        # the scan-per-query regime shared-scan exists for: with device
+        # residency on, a warm member rightly degrades to its resident solo
+        # run (pinned by test_resident_members_degrade_to_solo below) and
+        # repeated suite queries would never batch
+        "ballista.tpu.device_cache": "false",
+    }
+    base.update(over)
+    return base
+
+
+def _run_sequential(path, queries, client_settings=None, cluster_config=None):
+    """Reference harness: one client, queries one at a time (nothing can
+    co-pend, so nothing batches)."""
+    cluster = StandaloneCluster(n_executors=1, config=cluster_config)
+    try:
+        ctx = BallistaContext(
+            *cluster.scheduler_addr,
+            settings=client_settings or _client_settings(),
+        )
+        ctx.register_parquet("t", path)
+        out = [ctx.sql(q).collect().to_pydict() for q in queries]
+        ctx.close()
+        return out
+    finally:
+        cluster.shutdown()
+
+
+def _run_concurrent(
+    path, queries, client_settings=None, cluster_config=None,
+    per_query_settings=None, plan_delay=1.5, executors=1,
+    mid_flight=None, join_timeout=120,
+):
+    """Deterministic-batching harness: submit every query concurrently
+    against a cluster with NO executors, wait for planning, then start the
+    executor(s) — all compatible stage tasks are co-pending at first
+    dispatch. `per_query_settings[i]` overlays query i's client settings;
+    `mid_flight(cluster)` runs shortly after the executors start (executor
+    -death injection)."""
+    cluster = StandaloneCluster(n_executors=0, config=cluster_config)
+    results = [None] * len(queries)
+    errors = []
+    try:
+        def submit(i):
+            try:
+                settings = dict(client_settings or _client_settings())
+                if per_query_settings and per_query_settings[i]:
+                    settings.update(per_query_settings[i])
+                c = BallistaContext(*cluster.scheduler_addr, settings=settings)
+                c.register_parquet("t", path)
+                results[i] = c.sql(queries[i]).collect().to_pydict()
+                c.close()
+            except Exception as e:  # surfaced by the caller's assert
+                errors.append(f"q{i}: {e!r}")
+
+        threads = [
+            threading.Thread(target=submit, args=(i,))
+            for i in range(len(queries))
+        ]
+        for th in threads:
+            th.start()
+        time.sleep(plan_delay)
+        for i in range(executors):
+            ex = BallistaExecutor(
+                "127.0.0.1", cluster.port,
+                config=cluster.config, executor_id=f"late-{i}",
+            )
+            ex.start()
+            cluster.executors.append(ex)
+        if mid_flight is not None:
+            mid_flight(cluster)
+        for th in threads:
+            th.join(join_timeout)
+        alive = [th for th in threads if th.is_alive()]
+        assert not alive, f"clients hung: {len(alive)} (errors: {errors})"
+    finally:
+        cluster.shutdown()
+    assert not errors, errors
+    return results
+
+
+# -- batched == solo bit-identity + the sharing counters --------------------
+
+def test_batched_bit_identical_to_solo(table_path, monkeypatch):
+    """Concurrent distinct queries batch into ONE shared-scan launch
+    (SYNC_COMPILE pins the deterministic one-launch path) and every
+    member's result is BIT-identical to its solo run on the same backend;
+    the counters prove one upload and one launch served N stages."""
+    from ballista_tpu.ops import sharedscan
+
+    monkeypatch.setattr(sharedscan, "SYNC_COMPILE", True)
+    solo = _run_sequential(table_path, QUERIES)
+    shared_scan_stats(reset=True)
+    routing_stats(reset=True)
+    batched = _run_concurrent(table_path, QUERIES)
+    stats = shared_scan_stats(reset=True)
+    routing = routing_stats(reset=True)
+    for q, got, want in zip(QUERIES, batched, solo):
+        assert got == want, (q, got, want)
+    assert stats.get("batches_formed", 0) >= 1, stats
+    assert stats.get("batched_stages", 0) >= 2, stats
+    assert stats.get("shared_groups", 0) >= 1, stats
+    assert stats.get("uploads_saved", 0) >= 1, stats
+    assert stats.get("launches_saved", 0) >= 1, stats
+    # spliced members are visible routing decisions, not silent shortcuts
+    assert routing["engines"].get("batch", 0) >= 2, routing
+
+
+def test_cold_composition_falls_back_to_member_launches(table_path):
+    """A composition whose combined program is not compiled yet must NOT
+    stall the wave behind a multi-second trace: the members run their own
+    jitted steps over the SHARED upload (uploads still saved, results
+    bit-identical) while the one-launch program warms in the background."""
+    solo = _run_sequential(table_path, QUERIES)
+    shared_scan_stats(reset=True)
+    batched = _run_concurrent(table_path, QUERIES)
+    stats = shared_scan_stats(reset=True)
+    for q, got, want in zip(QUERIES, batched, solo):
+        assert got == want, (q, got, want)
+    assert stats.get("batches_formed", 0) >= 1, stats
+    assert stats.get("uploads_saved", 0) >= 1, stats
+    # cold compositions took the per-member fallback (or finished warming
+    # mid-run and switched — either way the wave never traced inline)
+    assert (
+        stats.get("warm_fallback_launches", 0) >= 1
+        or stats.get("launches_saved", 0) >= 1
+    ), stats
+
+
+def test_shared_scan_off_forms_no_batches(table_path):
+    cfg = BallistaConfig({"ballista.shared_scan": "false"})
+    shared_scan_stats(reset=True)
+    out = _run_concurrent(
+        table_path, QUERIES,
+        client_settings=_client_settings(**{"ballista.shared_scan": "false"}),
+        cluster_config=cfg,
+    )
+    assert all(o is not None for o in out)
+    assert shared_scan_stats(reset=True) == {}
+
+
+def test_resident_members_degrade_to_solo(table_path):
+    """With device residency ON and the member stages already warm, a
+    batched dispatch degrades every member to its resident solo run —
+    re-scanning what HBM already holds would undo the residency tier —
+    and results stay bit-identical."""
+    resident = _client_settings(**{"ballista.tpu.device_cache": "true"})
+    solo = _run_sequential(table_path, QUERIES, client_settings=resident)
+    shared_scan_stats(reset=True)
+    out = _run_concurrent(table_path, QUERIES, client_settings=resident)
+    stats = shared_scan_stats(reset=True)
+    for q, got, want in zip(QUERIES, out, solo):
+        assert got == want, (q, got, want)
+    # the scheduler may form batches (it cannot see executor residency);
+    # the executor's precompute hands every warm member back
+    assert stats.get("shared_groups", 0) == 0, stats
+    assert stats.get("uploads_saved", 0) == 0, stats
+
+
+# -- evidence gate ----------------------------------------------------------
+
+def test_evidence_gate_declines_predicted_slow_batches(table_path):
+    """With warm solo task.run rates and a stage.batch rate that predicts
+    the batch SLOWER than the members' solo sum, formation dispatches solo
+    — recorded (batch_gate_solo + a routing decision), never silent — and
+    results are unchanged. Re-seeding the batch rate fast re-enables
+    batching: the gate is evidence, not a switch."""
+    # warm the scheduler-observed task.run rates past MIN_OBSERVATIONS:
+    # 4 sequential runs of each shape = 4 completions per stage-1 op (the
+    # in-memory cost store is process-global and pinned to dir "")
+    _run_sequential(table_path, QUERIES * 4)
+    assert any(
+        k.startswith("task.run|") for k in costmodel.snapshot()
+    ), "warm pass recorded no task.run rates"
+    for k in (2.0, 4.0, 8.0):
+        costmodel.seed("stage.batch", k, 1e6, engine="task")
+    solo = _run_sequential(table_path, QUERIES)
+    shared_scan_stats(reset=True)
+    gated = _run_concurrent(table_path, QUERIES)
+    stats = shared_scan_stats(reset=True)
+    for q, got, want in zip(QUERIES, gated, solo):
+        assert got == want, (q, got, want)
+    assert stats.get("batches_formed", 0) == 0, stats
+    assert stats.get("batch_gate_solo", 0) >= 1, stats
+    # favorable evidence: batching resumes
+    for k in (2.0, 4.0, 8.0):
+        costmodel.seed("stage.batch", k, 1e-6, engine="task")
+    shared_scan_stats(reset=True)
+    fast = _run_concurrent(table_path, QUERIES)
+    stats = shared_scan_stats(reset=True)
+    for q, got, want in zip(QUERIES, fast, solo):
+        assert got == want, (q, got, want)
+    assert stats.get("batches_formed", 0) >= 1, stats
+
+
+# -- mixed compatible/incompatible groups -----------------------------------
+
+def test_mixed_compatibility_batches_only_compatible_members(table_path):
+    """A member whose stage reads a string device column cannot share the
+    upload (per-stage dictionaries); it degrades to solo while the
+    compatible members still batch — and everyone's result is exactly its
+    solo result."""
+    queries = QUERIES + [STRING_FILTER_QUERY]
+    solo = _run_sequential(table_path, queries)
+    shared_scan_stats(reset=True)
+    batched = _run_concurrent(table_path, queries)
+    stats = shared_scan_stats(reset=True)
+    for q, got, want in zip(queries, batched, solo):
+        assert got == want, (q, got, want)
+    assert stats.get("batches_formed", 0) >= 1, stats
+    assert stats.get("member_ineligible", 0) >= 1, stats
+
+
+# -- scheduler.batch chaos --------------------------------------------------
+
+def test_chaos_torn_batch_formation_degrades_to_solo(table_path):
+    """scheduler.batch chaos at rate 1.0 tears EVERY formation before any
+    sibling's Running flip: everything dispatches solo (nothing written,
+    nothing torn) and results stay bit-identical."""
+    solo = _run_sequential(table_path, QUERIES)
+    chaos_cfg = BallistaConfig({
+        "ballista.chaos.rate": "1.0",
+        "ballista.chaos.seed": "7",
+        "ballista.chaos.sites": "scheduler.batch",
+    })
+    shared_scan_stats(reset=True)
+    recovery_stats(reset=True)
+    out = _run_concurrent(table_path, QUERIES, cluster_config=chaos_cfg)
+    stats = shared_scan_stats(reset=True)
+    rec = recovery_stats(reset=True)
+    for q, got, want in zip(QUERIES, out, solo):
+        assert got == want, (q, got, want)
+    assert stats.get("batches_formed", 0) == 0, stats
+    assert stats.get("batch_chaos_solo", 0) >= 1, stats
+    assert rec.get("chaos_injected", 0) >= 1, rec
+
+
+# -- member failure isolation -----------------------------------------------
+
+def test_member_failure_spares_batch_siblings(table_path):
+    """One member's task.execute chaos (attempt 0 faulted, attempt 1 clean,
+    armed via that job's OWN settings) fails the member alone: its retry
+    completes and every batch sibling's result is bit-identical to solo."""
+    # find a seed that faults exactly the batchable stage-1 task's first
+    # attempt and nothing else the faulted job runs (stage 2 has
+    # shuffle.partitions=2 tasks)
+    seed = None
+    for cand in range(500):
+        inj = ChaosInjector(cand, 0.25, sites=("task.execute",))
+        if (
+            inj.should_inject("task.execute", "1/0@a0")
+            and not inj.should_inject("task.execute", "1/0@a1")
+            and not any(
+                inj.should_inject("task.execute", f"2/{p}@a0")
+                for p in range(2)
+            )
+        ):
+            seed = cand
+            break
+    assert seed is not None
+    solo = _run_sequential(table_path, QUERIES)
+    per_query = [None] * len(QUERIES)
+    per_query[1] = {
+        "ballista.chaos.rate": "0.25",
+        "ballista.chaos.seed": str(seed),
+        "ballista.chaos.sites": "task.execute",
+    }
+    shared_scan_stats(reset=True)
+    recovery_stats(reset=True)
+    out = _run_concurrent(
+        table_path, QUERIES, per_query_settings=per_query,
+    )
+    stats = shared_scan_stats(reset=True)
+    rec = recovery_stats(reset=True)
+    for q, got, want in zip(QUERIES, out, solo):
+        assert got == want, (q, got, want)
+    assert rec.get("task_retry", 0) >= 1, rec
+    assert stats.get("batches_formed", 0) >= 1, stats
+
+
+def test_executor_death_mid_batch_recovers_bit_identical(table_path):
+    """The executor dies WHILE a shared-scan batch runs on it (one member
+    slowed by task.slow keeps the batch in flight): every member's task
+    requeues through the normal lease machinery onto the replacement
+    executor and completes bit-identical to solo — a batched dispatch is N
+    ordinary in-flight tasks to every recovery path."""
+    import ballista_tpu.scheduler.state as state_mod
+
+    solo = _run_sequential(table_path, QUERIES)
+    per_query = [None] * len(QUERIES)
+    per_query[0] = {
+        # rate 1.0: EVERY attempt of this job's tasks sleeps, keeping the
+        # batch mid-flight when the victim dies (retries sleep too — the
+        # join timeout absorbs them)
+        "ballista.chaos.rate": "1.0",
+        "ballista.chaos.seed": "3",
+        "ballista.chaos.sites": "task.slow",
+        "ballista.chaos.slow_ms": "2500",
+    }
+
+    old_lease = state_mod.EXECUTOR_LEASE_SECS
+    state_mod.EXECUTOR_LEASE_SECS = 1.0
+
+    def kill_victim(cluster):
+        cluster.scheduler_impl.lost_task_check_interval = 0.5
+        time.sleep(0.8)  # the batch is dispatched and sleeping in a member
+        victim = cluster.executors[0]
+        victim.poll_loop.stop()
+        victim.flight.shutdown()
+        time.sleep(1.5)  # lease expiry
+        ex = BallistaExecutor(
+            "127.0.0.1", cluster.port,
+            config=cluster.config, executor_id="survivor",
+        )
+        ex.start()
+        cluster.executors.append(ex)
+
+    shared_scan_stats(reset=True)
+    recovery_stats(reset=True)
+    try:
+        out = _run_concurrent(
+            table_path, QUERIES, per_query_settings=per_query,
+            mid_flight=kill_victim, join_timeout=180,
+        )
+    finally:
+        state_mod.EXECUTOR_LEASE_SECS = old_lease
+    stats = shared_scan_stats(reset=True)
+    rec = recovery_stats(reset=True)
+    for q, got, want in zip(QUERIES, out, solo):
+        assert got == want, (q, got, want)
+    assert stats.get("batches_formed", 0) >= 1, stats
+    assert rec.get("lost_task_reset", 0) >= 1, rec
+
+
+# -- fuzz slice: concurrent distinct queries over shared tables -------------
+
+_FUZZ_AGGS = [
+    "sum(v)", "count(*)", "min(q)", "max(q)", "sum(q)", "min(d)", "max(d)",
+    "avg(v)",
+]
+_FUZZ_PREDS = ["v > 0", "q < 25", "d >= date '1995-01-01'", "v < 50 and q > 5"]
+
+
+def _fuzz_queries(qrng, k=3):
+    out = []
+    for _ in range(k):
+        key = str(qrng.choice(["g", "s", "g, s"]))
+        picks = list(qrng.choice(
+            _FUZZ_AGGS, size=int(qrng.integers(1, 4)), replace=False
+        ))
+        sel = ", ".join([key] + [f"{a} as a{i}" for i, a in enumerate(picks)])
+        sql = f"select {sel} from t"
+        if qrng.random() < 0.6:
+            sql += " where " + str(qrng.choice(_FUZZ_PREDS))
+        out.append(sql + f" group by {key} order by {key}")
+    return out
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_concurrent_shared_scan(tmp_path, seed):
+    """Fuzz slice (ISSUE 13): random concurrent DISTINCT aggregate queries
+    over one shared table, batched dispatch ON, compared bit-exactly
+    against the sequential (never-batched) run of the same cluster shape.
+    Own rng streams (22000+ data, 23000+ queries), so every baseline
+    stream in test_fuzz_device.py stays byte-identical."""
+    rng = np.random.default_rng(22000 + seed)
+    qrng = np.random.default_rng(23000 + seed)
+    n = int(rng.integers(5_000, 30_000))
+    t = pa.table({
+        "g": pa.array([f"k{v}" for v in rng.integers(0, 8, n)]),
+        "s": pa.array([f"x{v}" for v in rng.integers(0, 3, n)]),
+        "v": pa.array(np.round(rng.uniform(-1000, 1000, n), 2)),
+        "q": pa.array(rng.integers(1, 100, n), type=pa.int64()),
+        "d": pa.array(
+            rng.integers(8000, 12000, n), type=pa.int32()
+        ).cast(pa.date32()),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(t, path)
+    queries = _fuzz_queries(qrng)
+    solo = _run_sequential(path, queries)
+    batched = _run_concurrent(path, queries)
+    for q, got, want in zip(queries, batched, solo):
+        assert got == want, (q, got, want)

@@ -752,3 +752,73 @@ def test_speculation_rescues_seeded_straggler_end_to_end():
     finally:
         cluster.shutdown()
         costmodel.reset()
+
+
+# -- elapsed-ordered straggler heap (ISSUE 13 satellite, PR 11 residue) ------
+
+
+def test_straggler_heap_agrees_with_linear_scan():
+    """The heap-backed candidate walk must return exactly what the old
+    linear scan of _running_since would: every running task past the
+    speculation floor, most-elapsed first — including entries whose watch
+    clocks were re-stamped after their heap push (the reconcile path)."""
+    import numpy as np
+
+    cfg = _spec_config(**{"ballista.speculation.min_runtime_ms": "1000"})
+    s = SchedulerState(MemoryBackend(), "t", config=cfg)
+    _running_job(s)
+    s.save_executor_metadata(_meta("e1"))
+    rng = np.random.default_rng(7)
+    ages = {}
+    for p in range(24):
+        t = _pending("j", 1, p)
+        t.running.executor_id = "e1"
+        s.save_task_status(t)
+        # back-date like the promotion re-stamp does: rewrite the watch
+        # clock AND push the corrected entry (the superseded heap entry
+        # reconciles/dedupes lazily)
+        import heapq
+
+        age = float(rng.choice([0.0, 0.2, 0.9, 1.1, 2.5, 7.0, 30.0]))
+        owner, attempt, t0 = s._running_since[("j", 1, p)]
+        s._running_since[("j", 1, p)] = (owner, attempt, t0 - age)
+        heapq.heappush(s._running_heap, (t0 - age, ("j", 1, p)))
+        ages[("j", 1, p)] = age
+    now = time.monotonic()
+
+    def linear_reference():
+        out = [
+            k for k, e in s._running_since.items()
+            if now - e[2] >= s._spec_floor_s
+        ]
+        out.sort(key=lambda k: s._running_since[k][2])  # oldest first
+        return out
+
+    got = s._straggler_candidates(now)
+    assert got == linear_reference(), (got, linear_reference())
+    assert got, "the synthetic ages must produce candidates"
+    # repeated calls are stable: floor-passing entries re-push on exit
+    assert s._straggler_candidates(now) == got
+    # resolving a task removes it from candidates (lazy heap invalidation)
+    victim = got[0]
+    done = _completed(*victim, attempt=0, executor="e1")
+    s.save_task_status(done)
+    rest = s._straggler_candidates(now)
+    assert victim not in rest and rest == [k for k in got if k != victim]
+
+
+def test_straggler_heap_early_exits_on_young_tasks():
+    """An idle slot on a healthy cluster (every running task younger than
+    the floor) must not sweep the watch map: the t0-ordered heap walk
+    breaks at the first young entry and returns nothing."""
+    cfg = _spec_config(**{"ballista.speculation.min_runtime_ms": "60000"})
+    s = SchedulerState(MemoryBackend(), "t", config=cfg)
+    _running_job(s)
+    s.save_executor_metadata(_meta("e1"))
+    for p in range(8):
+        t = _pending("j", 1, p)
+        t.running.executor_id = "e1"
+        s.save_task_status(t)
+    assert s._straggler_candidates(time.monotonic()) == []
+    # the heap survives the walk intact for the next slot
+    assert len(s._running_heap) == 8

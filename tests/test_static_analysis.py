@@ -205,6 +205,25 @@ def test_failure_rule_speculation_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_batch_site_fixture_pair():
+    """ISSUE 13 satellite: the new scheduler.batch site is registered — an
+    unregistered grouping site and a computed site name in batching code
+    fail lint; the registered-literal shape (generation-rotated sequence
+    key) is clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_batch_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "scheduler.group" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_batch_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_routing_rule_fixture_pair():
     """ISSUE 10 satellite: a decline-helper call with no routing
     observation in scope and no cold-path annotation fails lint — a
