@@ -203,6 +203,14 @@ BALLISTA_TPU_COST_MODEL = "ballista.tpu.cost_model"
 # "" keeps the store in-memory only (observations still steer routing
 # within the process, nothing survives it).
 BALLISTA_TPU_COST_MODEL_DIR = "ballista.tpu.cost_model_dir"
+# -- concurrency analysis (ISSUE 14, utils/locks.py) ------------------------
+# dynamic lock witness: project locks record acquired-while-held edges at
+# runtime, assert the moment an acquisition inverts the canonical order in
+# dev/analysis/lockorder.toml (both stacks attached), and dump a witness
+# file for `python -m dev.analysis --check-witness`. Debug/CI mode —
+# enabling is process-global and sticky. Env equivalents:
+# BALLISTA_LOCK_WITNESS=1 / BALLISTA_LOCK_WITNESS_OUT=<path>.
+BALLISTA_DEBUG_LOCK_WITNESS = "ballista.debug.lock_witness"
 # -- deterministic fault injection (utils/chaos.py) -------------------------
 # rate > 0 arms the registered injection sites; each (site, key) pair draws
 # a DETERMINISTIC verdict from sha256(seed, site, key), so a chaos run is
@@ -277,6 +285,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_COST_MODEL_DIR: ".ballista_cache/costmodel",
     BALLISTA_RPC_RETRIES: "3",
     BALLISTA_RPC_BACKOFF_MS: "50",
+    BALLISTA_DEBUG_LOCK_WITNESS: "false",
     BALLISTA_CHAOS_SEED: "0",
     BALLISTA_CHAOS_RATE: "0",
     BALLISTA_CHAOS_SITES: "",
@@ -531,6 +540,10 @@ class BallistaConfig(Mapping[str, str]):
     def rpc_backoff_s(self) -> float:
         """Jittered-exponential backoff base, in seconds."""
         return max(0.0, float(self._settings[BALLISTA_RPC_BACKOFF_MS])) / 1000.0
+
+    def debug_lock_witness(self) -> bool:
+        # ISSUE 14: arm the dynamic lock-order witness (utils/locks.py)
+        return self._settings[BALLISTA_DEBUG_LOCK_WITNESS].lower() in ("1", "true", "yes")
 
     def chaos_seed(self) -> int:
         return int(self._settings[BALLISTA_CHAOS_SEED])

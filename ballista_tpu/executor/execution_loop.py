@@ -41,6 +41,7 @@ from ballista_tpu.executor.flight_service import flight_shuffle_fetcher
 from ballista_tpu.physical.plan import TaskContext
 from ballista_tpu.proto import ballista_pb2 as pb
 from ballista_tpu.scheduler.rpc import SchedulerGrpcClient
+from ballista_tpu.utils.locks import make_lock
 
 log = logging.getLogger("ballista.executor")
 
@@ -59,10 +60,14 @@ class PollLoop:
     ) -> None:
         from ballista_tpu.utils.chaos import chaos_from_config
 
+        from ballista_tpu.utils import locks as _locks
+
         self.scheduler = scheduler
         self.metadata = metadata
         self.work_dir = work_dir
         self.config = config or BallistaConfig()
+        # ISSUE 14: arm the dynamic lock-order witness when configured
+        _locks.maybe_enable_from_config(self.config)
         self.concurrent_tasks = concurrent_tasks
         self._available = threading.Semaphore(concurrent_tasks)
         self._finished: "queue.Queue[pb.TaskStatus]" = queue.Queue()
@@ -70,7 +75,7 @@ class PollLoop:
         # lifecycle state shared between the poll thread and start()/stop()
         # callers (the queue/semaphore/event above are internally
         # thread-safe and need no extra guard)
-        self._mu = threading.Lock()
+        self._mu = make_lock("executor.execution_loop._mu")
         self._thread: Optional[threading.Thread] = None  # guarded-by: self._mu
         # shuffle-dir GC: the reference never collects work dirs
         # (SURVEY §5 "Nothing garbage-collects work dirs")
@@ -89,8 +94,10 @@ class PollLoop:
         # task in Running forever). The echo carries the ATTEMPT so a
         # restarted scheduler's ledger re-adoption never accepts a stale
         # attempt's vouch (ISSUE 6).
-        self._inflight_mu = threading.Lock()
-        self._inflight: dict = {}  # (job, stage, part) -> (PartitionId, attempt); guarded-by: self._inflight_mu
+        self._inflight_mu = make_lock("executor.execution_loop._inflight_mu")
+        # (job, stage, part) -> (PartitionId, attempt)
+        # guarded-by: self._inflight_mu
+        self._inflight: dict = {}
         # -- push dispatch (ISSUE 8) ------------------------------------
         self._push_enabled = self.config.push_dispatch()
         self._idle_poll_max = self.config.idle_poll_max_s()

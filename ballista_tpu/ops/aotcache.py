@@ -43,13 +43,14 @@ import os
 import tempfile
 import threading
 from typing import Dict, List, Optional, Tuple
+from ballista_tpu.utils.locks import make_lock
 
 log = logging.getLogger("ballista.tpu.aot")
 
 # bump to orphan every persisted program (they are re-derived, not migrated)
 _FORMAT = 1
 
-_lock = threading.Lock()
+_lock = make_lock("ops.aotcache._lock")
 _dir: str = ""  # "" = disabled; guarded-by: _lock
 _chaos = None  # guarded-by: _lock
 # full key -> ("fresh", None) | ("disk"|"prewarm", compiled flat callable)

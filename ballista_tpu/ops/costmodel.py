@@ -59,6 +59,7 @@ import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, Optional, Tuple
+from ballista_tpu.utils.locks import make_lock
 
 # bump to orphan every persisted entry (they are re-measured, not migrated).
 # 2: stage.run units changed from 1 to input bytes/rows (ISSUE 11) — a
@@ -78,7 +79,7 @@ _FLUSH_INTERVAL_S = 5.0
 # observed/predicted ratio beyond which a decision counts as a mispredict
 MISPREDICT_FACTOR = 3.0
 
-_lock = threading.Lock()
+_lock = make_lock("ops.costmodel._lock")
 _dir: str = ""  # "" = in-memory only; guarded-by: _lock
 # deliberately lock-free: a single bool written by configure()/reset() and
 # read on hot paths (readback, h2d) — CPython bool loads are atomic and a

@@ -32,6 +32,7 @@ from ballista_tpu.ops.runtime import (
     pad_to,
     readback,
 )
+from ballista_tpu.utils.locks import make_lock
 
 
 def decline(reason: str):
@@ -151,9 +152,8 @@ def join_extended_tier(
 # atomic section or two threads can each build (and pin) the same stage.
 # (Tests reach in to clear these between cases — cross-file accesses are
 # outside the file-scoped guarded-by check by design.)
-import threading as _threading
 
-_stage_cache_lock = _threading.Lock()
+_stage_cache_lock = make_lock("ops.kernels._stage_cache_lock")
 _stage_cache: Dict[str, object] = {}  # guarded-by: _stage_cache_lock
 # pins each cached stage's table source so its id() (part of the cache key
 # for memory scans) can never be recycled by a different object

@@ -85,10 +85,11 @@ def _dir_bytes(base: str) -> int:
 # per save is O(entries^2) stat traffic as the cache fills. The estimate is
 # refreshed with a real walk only when it says the cap is exceeded (other
 # processes' writes are invisible until then — the cap stays best-effort).
-import threading as _threading
+from ballista_tpu.utils.locks import make_lock
 
-_size_lock = _threading.Lock()
-_size_cache: Dict[str, int] = {}  # base dir -> bytes; guarded-by: _size_lock
+_size_lock = make_lock("ops.layout_cache._size_lock")
+# guarded-by: _size_lock
+_size_cache: Dict[str, int] = {}  # base dir -> bytes
 
 
 def _size_note(base: str, delta: int) -> None:

@@ -55,6 +55,7 @@ from ballista_tpu.physical.plan import (
     TaskContext,
     collect_all,
 )
+from ballista_tpu.utils.locks import make_lock
 
 # dim subtrees larger than this are not dimension maps; host joins them.
 # Sized for SF=100 TPC-H: q12/q7 attach the whole orders table (~150M rows,
@@ -146,8 +147,8 @@ class MappedScanExec(ExecutionPlan):
                 fields.extend(list(a.dim.schema()))
         fields.append(pa.field("__member", pa.int8()))
         self._schema = pa.schema(fields)
-        self._maps: Optional[List[dict]] = None
-        self._lock = threading.Lock()
+        self._maps: Optional[List[dict]] = None  # guarded-by: self._lock
+        self._lock = make_lock("ops.mappedscan._lock")
 
     def schema(self) -> pa.Schema:
         return self._schema
@@ -172,6 +173,8 @@ class MappedScanExec(ExecutionPlan):
         return f"MappedScanExec: {len(self.attachments)} attachments [{parts}]"
 
     # ------------------------------------------------------------------
+    # collects dimension plans while holding the lock (see join.py note)
+    # may-acquire: group:exec_substrate
     def _ensure_maps(self, ctx: TaskContext) -> List[dict]:
         with self._lock:
             if self._maps is not None:

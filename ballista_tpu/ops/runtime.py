@@ -21,6 +21,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from ballista_tpu.errors import ExecutionError
+from ballista_tpu.utils.locks import make_lock
 
 
 class UnsupportedOnDevice(Exception):
@@ -38,10 +39,8 @@ class ColumnDictionary:
     already baked into pinned device tiles."""
 
     def __init__(self) -> None:
-        import threading
-
         self.values: Optional[pa.Array] = None  # distinct values; guarded-by: self._lock
-        self._lock = threading.Lock()
+        self._lock = make_lock("ops.runtime._lock")
 
     def encode(self, arr: pa.Array) -> np.ndarray:
         with self._lock:
@@ -132,7 +131,7 @@ class ScanDictionaries:
 import threading
 import time
 
-_res_lock = threading.Lock()
+_res_lock = make_lock("ops.runtime._res_lock")
 _resident_bytes = 0  # guarded-by: _res_lock
 _reservations: dict = {}  # token -> bytes; guarded-by: _res_lock
 _pinned: dict = {}  # token -> (stage, partition), for LRU; guarded-by: _res_lock
@@ -635,7 +634,7 @@ def pipelined_map(src, fn, workers: int, depth: int = 2, on_src_time=None):
 # ranking), encode_s = host narrow/encode, upload_s = h2d transfer, wall_s =
 # end-to-end prepare. overlap_frac = 1 - wall / (scan + encode + upload):
 # 0 on the serial path, > 0 when the pipeline actually hid host work.
-_ingest_lock = threading.Lock()
+_ingest_lock = make_lock("ops.runtime._ingest_lock")
 # guarded-by: _ingest_lock
 _ingest_totals = {
     "scan_s": 0.0, "encode_s": 0.0, "upload_s": 0.0, "wall_s": 0.0,
@@ -675,7 +674,7 @@ def ingest_stats(reset: bool = False) -> Dict[str, float]:
 # result (groups or selected candidates), bytes = the packed f32 transfer
 # size. The fused Sort+Limit epilogue's whole point is to shrink these to
 # O(limit); readbacks is the transfer count.
-_readback_lock = threading.Lock()
+_readback_lock = make_lock("ops.runtime._readback_lock")
 _readback_totals = {"rows": 0, "bytes": 0, "readbacks": 0}  # guarded-by: _readback_lock
 
 
@@ -734,9 +733,11 @@ def readback_stats(reset: bool = False) -> Dict[str, int]:
 # "step_aside" (the multiplicity/gather admission tier declined, host join
 # ran instead), or "host_fallback" (any other decline or error). Reasons are
 # counted verbatim so a bench row says WHY a join left the device path.
-_join_lock = threading.Lock()
-_join_paths: Dict[str, int] = {}  # path -> count; guarded-by: _join_lock
-_join_reasons: Dict[str, int] = {}  # "path: reason" -> count; guarded-by: _join_lock
+_join_lock = make_lock("ops.runtime._join_lock")
+# guarded-by: _join_lock
+_join_paths: Dict[str, int] = {}  # path -> count
+# guarded-by: _join_lock
+_join_reasons: Dict[str, int] = {}  # "path: reason" -> count
 
 
 def record_join_path(path: str, reason: Optional[str] = None) -> None:
@@ -770,8 +771,9 @@ def join_path_stats(reset: bool = False) -> Dict[str, Dict[str, int]]:
 # work they triggered. In-process accumulator like the readback totals —
 # the standalone cluster (scheduler + executors in one process) is where
 # chaos runs live; separate daemons each report their own share.
-_recovery_lock = threading.Lock()
-_recovery: Dict[str, int] = {}  # event -> count; guarded-by: _recovery_lock
+_recovery_lock = make_lock("ops.runtime._recovery_lock")
+# guarded-by: _recovery_lock
+_recovery: Dict[str, int] = {}  # event -> count
 
 
 def record_recovery(event: str, n: int = 1) -> None:
@@ -793,8 +795,9 @@ def recovery_stats(reset: bool = False) -> Dict[str, int]:
 # deferrals. Same in-process accumulator pattern as the recovery counters;
 # bench.py's multi-tenant scenario reports cache-hit rate and per-tenant
 # fairness off these plus the scheduler's per-tenant assignment ledger.
-_tenancy_lock = threading.Lock()
-_tenancy: Dict[str, int] = {}  # event -> count; guarded-by: _tenancy_lock
+_tenancy_lock = make_lock("ops.runtime._tenancy_lock")
+# guarded-by: _tenancy_lock
+_tenancy: Dict[str, int] = {}  # event -> count
 
 
 def record_tenancy(event: str, n: int = 1) -> None:
@@ -823,8 +826,9 @@ def tenancy_stats(reset: bool = False) -> Dict[str, int]:
 # every loss), and streaming-collect progress (stream_partition_early = a result
 # partition fetched before the job completed). Same in-process accumulator
 # pattern as readback/join_paths/recovery/tenancy above.
-_serving_lock = threading.Lock()
-_serving: Dict[str, int] = {}  # event -> count; guarded-by: _serving_lock
+_serving_lock = make_lock("ops.runtime._serving_lock")
+# guarded-by: _serving_lock
+_serving: Dict[str, int] = {}  # event -> count
 
 
 def record_serving(event: str, n: int = 1) -> None:
@@ -851,8 +855,9 @@ def serving_stats(reset: bool = False) -> Dict[str, int]:
 # or within their ballista.tenant.slo_ms deadline). Same in-process
 # accumulator pattern as recovery/tenancy/serving above; bench.py reports a
 # per-config `speculation` block off this beside `recovery`/`routing`.
-_speculation_lock = threading.Lock()
-_speculation: Dict[str, float] = {}  # event -> count/seconds; guarded-by: _speculation_lock
+_speculation_lock = make_lock("ops.runtime._speculation_lock")
+# guarded-by: _speculation_lock
+_speculation: Dict[str, float] = {}  # event -> count/seconds
 
 
 def record_speculation(event: str, n: float = 1) -> None:
@@ -881,8 +886,9 @@ def speculation_stats(reset: bool = False) -> Dict[str, float]:
 # to solo execution — bit-identical either way). Same in-process accumulator
 # pattern as recovery/tenancy/serving above; bench.py reports a per-scenario
 # `shared_scan` block off this.
-_shared_scan_lock = threading.Lock()
-_shared_scan: Dict[str, int] = {}  # event -> count; guarded-by: _shared_scan_lock
+_shared_scan_lock = make_lock("ops.runtime._shared_scan_lock")
+# guarded-by: _shared_scan_lock
+_shared_scan: Dict[str, int] = {}  # event -> count
 
 
 def record_shared_scan(event: str, n: int = 1) -> None:
@@ -907,7 +913,7 @@ def shared_scan_stats(reset: bool = False) -> Dict[str, int]:
 # A decision whose observed cost deviates from its prediction by more than
 # costmodel.MISPREDICT_FACTOR either way counts as a mispredict; the
 # mispredict rate is the model's running honesty meter.
-_routing_lock = threading.Lock()
+_routing_lock = make_lock("ops.runtime._routing_lock")
 # guarded-by: _routing_lock
 _routing = {
     "engines": {},  # engine -> decision count

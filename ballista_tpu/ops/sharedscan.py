@@ -69,6 +69,7 @@ import numpy as np
 import pyarrow as pa
 
 from ballista_tpu.ops.runtime import UnsupportedOnDevice
+from ballista_tpu.utils.locks import make_lock
 
 log = logging.getLogger("ballista.sharedscan")
 
@@ -688,7 +689,7 @@ def _run_group_locked(members: List[_Member], res: SharedResults) -> None:
 # actually been traced/compiled (by a background warm call or an earlier
 # wave), so a serving wave never stalls behind a multi-second trace; the
 # in-flight set bounds concurrent background compiles to one per signature.
-_combined_lock = threading.Lock()
+_combined_lock = make_lock("ops.sharedscan._combined_lock")
 _combined_cache: Dict[tuple, object] = {}  # guarded-by: _combined_lock
 _combined_warm: set = set()  # guarded-by: _combined_lock
 _combined_warming: set = set()  # guarded-by: _combined_lock

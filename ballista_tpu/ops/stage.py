@@ -43,6 +43,7 @@ from ballista_tpu.physical.basic import (
     ProjectionExec,
 )
 from ballista_tpu.physical.scan import CsvScanExec, MemoryScanExec, ParquetScanExec
+from ballista_tpu.utils.locks import make_lock
 
 _SCAN_TYPES = (CsvScanExec, ParquetScanExec, MemoryScanExec)
 
@@ -517,9 +518,7 @@ class FusedAggregateStage:
         # executor task threads can run different partitions of one cached
         # stage concurrently; prepare mutates shared state (the growing
         # ColumnDictionary, compiled-step slots), so it is serialized
-        import threading
-
-        self._prepare_lock = threading.Lock()
+        self._prepare_lock = make_lock("ops.stage._prepare_lock")
         # name -> fn(row-space npcols dict) -> np row array; materialized as
         # [V, L1] tiles alongside the scan columns on the sorted path
         # (FactAggregateStage derives static mapped columns this way)
@@ -999,6 +998,7 @@ class FusedAggregateStage:
         hi, lo = floatbits.i64_to_planes(floatbits.f64_to_i64(vals))
         return {hk: hi, lk: lo}
 
+    # holds-lock: self._prepare_lock
     def _prepare_partition(self, partition: int, ctx) -> List[dict]:
         """Host work for one partition: scan, encode, pad, transfer. Returns
         per-batch device-input entries (jnp column arrays stay resident).
@@ -1214,6 +1214,7 @@ class FusedAggregateStage:
             )
         return {"kind": "batches", "entries": entries}
 
+    # holds-lock: self._prepare_lock
     def _prepare_partition_sorted(self, partition: int, ctx) -> dict:
         """High-cardinality path: whole-partition chunked-segment layout
         (ops/layout.py). Sorting/ranking/materialization is cache-time host
@@ -1455,6 +1456,7 @@ class FusedAggregateStage:
             ctx.config.tpu_layout_cache_cap(),
         )
 
+    # holds-lock: self._prepare_lock
     def _load_layout(self, partition: int, ctx, want=("sorted", "batches")):
         """Rehydrate a persisted partition of either kind: adopt the
         dictionary snapshot (live dicts must be a prefix — codes in the

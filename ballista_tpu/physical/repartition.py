@@ -23,6 +23,7 @@ from ballista_tpu.physical.plan import (
     TaskContext,
     batch_table,
 )
+from ballista_tpu.utils.locks import make_lock
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:
@@ -106,8 +107,8 @@ class RepartitionExec(ExecutionPlan):
     def __init__(self, input: ExecutionPlan, partitioning: Partitioning) -> None:
         self.input = input
         self.partitioning = partitioning
-        self._lock = threading.Lock()
-        self._splits: Optional[List[pa.Table]] = None
+        self._lock = make_lock("physical.repartition._lock")
+        self._splits: Optional[List[pa.Table]] = None  # guarded-by: self._lock
 
     def schema(self) -> pa.Schema:
         return self.input.schema()
@@ -134,6 +135,8 @@ class RepartitionExec(ExecutionPlan):
             part_ids = np.arange(batch.num_rows, dtype=np.int64) % n_out
         return split_by_partition(batch, part_ids, n_out)
 
+    # executes the input plan while holding the lock (see join.py note)
+    # may-acquire: group:exec_substrate
     def _materialize(self, ctx: TaskContext) -> List[pa.Table]:
         with self._lock:
             if self._splits is None:

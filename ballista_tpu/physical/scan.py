@@ -16,11 +16,11 @@ import pyarrow.parquet
 # host decoded-table cache (parquet), capped by total bytes, FIFO-evicted.
 # Keys are (path, mtime, cols); a rewritten file gets a new key and the old
 # entry for the same (path, cols) is dropped eagerly.
-import threading as _threading
+from ballista_tpu.utils.locks import make_lock
 
-_TABLE_CACHE: Dict[tuple, pa.Table] = {}
-_TABLE_CACHE_BYTES = [0]
-_TABLE_CACHE_MU = _threading.Lock()
+_TABLE_CACHE: Dict[tuple, pa.Table] = {}  # guarded-by: _TABLE_CACHE_MU
+_TABLE_CACHE_BYTES = [0]  # guarded-by: _TABLE_CACHE_MU
+_TABLE_CACHE_MU = make_lock("physical.scan._TABLE_CACHE_MU")
 
 
 def _cache_get(key: tuple) -> Optional[pa.Table]:

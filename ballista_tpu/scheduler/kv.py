@@ -20,8 +20,9 @@ from __future__ import annotations
 import contextlib
 import os
 import sqlite3
-import threading
 import time
+
+from ballista_tpu.utils.locks import make_rlock
 from typing import Dict, Iterator, List, Optional, Tuple
 
 
@@ -60,7 +61,7 @@ class KvBackend:
 class MemoryBackend(KvBackend):
     def __init__(self) -> None:
         self._data: Dict[str, Tuple[bytes, Optional[float]]] = {}  # guarded-by: self._mu
-        self._mu = threading.RLock()
+        self._mu = make_rlock("scheduler.kv.lock")
 
     # holds-lock: self._mu
     def _live(self, key: str) -> Optional[bytes]:
@@ -121,7 +122,7 @@ class SqliteBackend(KvBackend):
     def __init__(self, path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._path = path
-        self._mu = threading.RLock()
+        self._mu = make_rlock("scheduler.kv.lock")
         # one shared connection, serialized by self._mu (sqlite3 objects are
         # not thread-safe under check_same_thread=False without it)
         self._conn = sqlite3.connect(path, check_same_thread=False)  # guarded-by: self._mu
@@ -136,7 +137,7 @@ class SqliteBackend(KvBackend):
         """In-memory sqlite for tests (ref StandaloneClient::try_new_temporary)."""
         obj = cls.__new__(cls)
         obj._path = ":memory:"
-        obj._mu = threading.RLock()
+        obj._mu = make_rlock("scheduler.kv.lock")
         obj._conn = sqlite3.connect(":memory:", check_same_thread=False)
         obj._conn.execute(
             "CREATE TABLE IF NOT EXISTS kv ("

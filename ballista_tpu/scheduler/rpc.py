@@ -16,6 +16,7 @@ from typing import Optional
 import grpc
 
 from ballista_tpu.proto import ballista_pb2 as pb
+from ballista_tpu.utils.locks import make_lock
 
 SERVICE_NAME = "ballista.SchedulerGrpc"
 
@@ -129,8 +130,10 @@ class SchedulerGrpcClient:
         self.retries = max(0, retries)
         self.backoff_s = backoff_s
         self.chaos = chaos
-        self._chaos_mu = threading.Lock()
-        self._chaos_calls: dict = {}  # method -> call count; guarded-by: self._chaos_mu
+        self._chaos_mu = make_lock("scheduler.rpc._chaos_mu")
+        # method -> call count
+        # guarded-by: self._chaos_mu
+        self._chaos_calls: dict = {}
         self._stubs = {}
         for name, (req_cls, resp_cls) in _METHODS.items():
             self._stubs[name] = self.channel.unary_unary(

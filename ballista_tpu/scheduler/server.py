@@ -34,6 +34,7 @@ from ballista_tpu.scheduler.rpc import add_scheduler_service
 from ballista_tpu.scheduler.state import SchedulerState
 from ballista_tpu.serde.arrow import schema_to_ipc
 from ballista_tpu.serde.logical import plan_from_proto
+from ballista_tpu.utils.locks import make_lock
 
 log = logging.getLogger("ballista.scheduler")
 
@@ -83,6 +84,11 @@ class SchedulerServer:
         synchronous_planning: bool = False,
     ) -> None:
         self.config = config or BallistaConfig()
+        # ISSUE 14: one config flag arms the dynamic lock-order witness for
+        # the whole process (scheduler threads, stream generators, pumps)
+        from ballista_tpu.utils import locks as _locks
+
+        _locks.maybe_enable_from_config(self.config)
         self.state = SchedulerState(kv or MemoryBackend(), namespace, config=self.config)
         # restart recovery BEFORE serving: discard torn (uncommitted) jobs,
         # reload the durable assignment ledger with a fresh grace window
@@ -92,8 +98,10 @@ class SchedulerServer:
         # statements executed through the scheduler register here)
         self.catalog = ExecutionContext(self.config)
         self.synchronous_planning = synchronous_planning
-        self._lock = threading.Lock()
-        self._last_lost_check = 0.0
+        # dead-executor sweep clock, touched only inside PollWork's global
+        # lock (the `self._lock = threading.Lock()` that used to sit here
+        # guarded nothing — the ISSUE 14 coverage sweep retired it)
+        self._last_lost_check = 0.0  # guarded-by: self.state.kv.lock()
         # deterministic scheduler-death injection (utils/chaos.py
         # "scheduler.crash"): keyed on the ACCEPTED-STATUS sequence so the
         # seeded crash lands mid-job (statuses only exist after planning
@@ -119,7 +127,7 @@ class SchedulerServer:
         # cached value is the serialized proto, deserialized fresh per job:
         # plan trees are mutable (stage split, operator state) and must
         # never be shared across planner invocations.
-        self._plan_cache_mu = threading.Lock()
+        self._plan_cache_mu = make_lock("scheduler.server._plan_cache_mu")
         self._plan_cache: "dict[str, bytes]" = {}  # guarded-by: self._plan_cache_mu
         self._plan_cache_cap = 128
         # push-based task dispatch (ISSUE 8): executor id -> open stream.
@@ -128,7 +136,7 @@ class SchedulerServer:
         # Ordering: kv.lock() may be held when _push_mu is taken (pump),
         # NEVER the reverse.
         self.push_enabled = self.config.push_dispatch()
-        self._push_mu = threading.Lock()
+        self._push_mu = make_lock("scheduler.server._push_mu")
         self._subscribers: Dict[str, _PushSubscriber] = {}  # guarded-by: self._push_mu
         self._push_seq = 0  # scheduler.push chaos rotation; under the kv lock
         # push job-status notifications (ISSUE 11): job id -> queues of
@@ -138,7 +146,7 @@ class SchedulerServer:
         # short-lived. Queue puts are internally thread-safe; the dict is
         # guarded by its own lock (never taken with the KV lock held by
         # anything that blocks).
-        self._status_mu = threading.Lock()
+        self._status_mu = make_lock("scheduler.server._status_mu")
         self._status_subs: Dict[str, list] = {}  # guarded-by: self._status_mu
         # job -> last pushed serialized status: synchronize_job_status
         # re-writes a byte-identical running status on every non-final

@@ -42,6 +42,7 @@ from ballista_tpu.distributed.stages import ShuffleLocation, ShuffleWriterExec
 from ballista_tpu.proto import ballista_pb2 as pb
 from ballista_tpu.scheduler.kv import KvBackend
 from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
+from ballista_tpu.utils.locks import make_lock
 
 log = logging.getLogger("ballista.scheduler")
 
@@ -297,7 +298,7 @@ class SchedulerState:
         # per-tenant assignment totals behind bench's fairness report.
         # Both are touched from PollWork (under the global KV lock) AND from
         # ExecuteQuery / test probes, so they carry their own lock.
-        self._tenant_mu = threading.Lock()
+        self._tenant_mu = make_lock("scheduler.state._tenant_mu")
         # job -> (tenant, priority, created_at); guarded-by: self._tenant_mu
         self._tenant_cache: Dict[str, Tuple[str, int, float]] = {}
         self.tenant_assigned: Dict[str, int] = {}  # guarded-by: self._tenant_mu
@@ -1699,18 +1700,37 @@ class SchedulerState:
             self._batch_members.clear()
             self._batches.clear()
         job_live: Dict[str, bool] = {}
-        inflight = (
-            self._tenant_inflight(idx) if self._tenant_quota > 0 else None
-        )
         alive_others = {
             m.id for m in self.get_executors_metadata()
         } - {executor_id}
         candidates: List[Tuple[str, int, object]] = []
-        for (job_id, stage_id), parts in list(idx.pending.items()):
-            if len(candidates) >= self._shared_max_batch - 1:
-                break
-            if job_id == pid.job_id or partition not in parts:
-                continue
+        # weighted fair-share sibling ordering (ISSUE 14 satellite, PR 13
+        # residue): candidates are visited lightest-tenant-first by the
+        # SAME smallest in_flight/weight key assign_next_schedulable_task
+        # uses, re-ranked as this batch claims slots — one heavy tenant
+        # can no longer fill every sibling slot of a shared batch while a
+        # lighter tenant has co-pending compatible work. Untenanted
+        # deployments (one "" tenant) reduce to a stable (job, stage)
+        # order. The same running+claimed counts enforce the in-flight
+        # quota, so a whole batch can never claim past the bound.
+        weights = self._tenant_weights
+        rank_inflight = self._tenant_inflight(idx)
+        remaining = [
+            (key, parts) for key, parts in idx.pending.items()
+            if key[0] != pid.job_id and partition in parts
+        ]
+
+        def fair_key(item):
+            (job_id, stage_id), _parts = item
+            tenant = self.job_tenant(job_id)[0]
+            return (
+                rank_inflight.get(tenant, 0) / weights.get(tenant, 1),
+                tenant, job_id, str(stage_id),
+            )
+
+        while remaining and len(candidates) < self._shared_max_batch - 1:
+            remaining.sort(key=fair_key)
+            (job_id, stage_id), parts = remaining.pop(0)
             if job_id not in job_live:
                 js = self.get_job_metadata(job_id)
                 job_live[job_id] = js is not None and js.WhichOneof(
@@ -1718,16 +1738,10 @@ class SchedulerState:
                 ) == "running"
             if not job_live[job_id]:
                 continue
-            if inflight is not None:
-                # a batched sibling bypasses the fair-share visit order;
-                # it must still respect its tenant's in-flight quota —
-                # counting the candidates THIS batch is about to claim
-                # (a stale snapshot would admit a whole batch past the
-                # bound)
-                tenant = self.job_tenant(job_id)[0]
-                if inflight.get(tenant, 0) >= self._tenant_quota:
-                    continue
-                inflight[tenant] = inflight.get(tenant, 0) + 1
+            tenant = self.job_tenant(job_id)[0]
+            if self._tenant_quota > 0 and \
+                    rank_inflight.get(tenant, 0) >= self._tenant_quota:
+                continue
             # cheap screen first: the cached per-(job, stage) signature —
             # only a MATCH pays the plan bind (which the dispatched
             # sibling TaskDefinition needs anyway)
@@ -1740,6 +1754,7 @@ class SchedulerState:
             except Exception:
                 continue
             candidates.append((job_id, stage_id, bound))
+            rank_inflight[tenant] = rank_inflight.get(tenant, 0) + 1
         if not candidates:
             return []
         # evidence gate (cost model, ISSUE 13): predicted batch wall vs the

@@ -19,11 +19,12 @@ import os
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
+from ballista_tpu.utils.locks import make_lock
 
 _local = threading.local()
-_all_spans: List[Tuple[str, float, int]] = []  # (path, seconds, depth)
-_counters: Dict[str, int] = {}
-_mu = threading.Lock()
+_all_spans: List[Tuple[str, float, int]] = []  # (path, seconds, depth); guarded-by: _mu
+_counters: Dict[str, int] = {}  # guarded-by: _mu
+_mu = make_lock("utils.tracing._mu")
 
 
 def _stack() -> List[str]:

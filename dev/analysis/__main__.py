@@ -7,11 +7,48 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from dev.analysis.core import RULE_NAMES, run_paths
 
 SUPPRESSION_BUDGET = 5  # package-wide cap (ISSUE 3 acceptance criteria)
+
+
+def check_witness(witness_path: str, paths, as_json: bool = False,
+                  use_cache: bool = True, cache_path=None) -> int:
+    """--check-witness: runtime-vs-static lock-order cross-check (ISSUE 14).
+
+    Exit 1 when the witness recorded edges the static analyzer never
+    derived (analyzer bugs / missing may-acquire annotations) or recorded
+    order violations; stale declared edges only warn."""
+    from dev.analysis.lockgraph import Manifest, diff_witness, load_witness
+    from dev.analysis.rules_lockorder import static_edges
+
+    try:
+        witness = load_witness(witness_path)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read witness {witness_path}: {e}",
+              file=sys.stderr)
+        return 2
+    edges = static_edges(paths, use_cache=use_cache, cache_path=cache_path)
+    report = diff_witness(witness, edges, Manifest.load())
+    report["static_edges"] = len(edges)
+    report["ok"] = not report["missed"] and not report["violations"]
+    if as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"witness: {report['runtime_edges']} runtime edge(s), "
+              f"{report['static_edges']} static edge(s)")
+        for s, d in report["missed"]:
+            print(f"MISSED statically: {s} -> {d} (analyzer bug or missing "
+                  "`# may-acquire:` on a dynamic-dispatch seam)")
+        for v in report["violations"]:
+            print(f"RUNTIME VIOLATION: {v.get('kind')} "
+                  f"{v.get('src', v.get('lock'))} -> {v.get('dst', '')}")
+        for s, d in report["never_witnessed"]:
+            print(f"stale (declared, never witnessed): {s} -> {d}")
+    return 0 if report["ok"] else 1
 
 
 def main(argv=None) -> int:
@@ -33,6 +70,16 @@ def main(argv=None) -> int:
                     help="fail when the tree carries more reasoned "
                          f"suppressions than this (default {SUPPRESSION_BUDGET}; "
                          "-1 disables)")
+    ap.add_argument("--jobs", type=int, default=1,
+                    help="process-pool width for per-file analysis (same "
+                         "cache semantics, deterministic report order; "
+                         "0 = one per CPU)")
+    ap.add_argument("--check-witness", metavar="WITNESS_JSON", default=None,
+                    help="diff a runtime lock-witness dump "
+                         "(ballista.debug.lock_witness) against the static "
+                         "lock-order graph: runtime edges the analyzer "
+                         "missed fail; declared-but-never-witnessed edges "
+                         "are flagged stale")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -40,9 +87,17 @@ def main(argv=None) -> int:
             print(r)
         return 0
     paths = args.paths or ["ballista_tpu"]
+    jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
+
+    if args.check_witness is not None:
+        return check_witness(args.check_witness, paths, as_json=args.as_json,
+                             use_cache=not args.no_cache,
+                             cache_path=args.cache_file)
+
     try:
         findings, stats = run_paths(
-            paths, use_cache=not args.no_cache, cache_path=args.cache_file
+            paths, use_cache=not args.no_cache, cache_path=args.cache_file,
+            jobs=jobs,
         )
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)

@@ -17,6 +17,12 @@ META_RULE = "lint-usage"
 
 # populated by dev.analysis.rules at import time (rule name -> check fn)
 _REGISTRY: Dict[str, object] = {}
+# per-file fact extractors feeding whole-program passes (name -> fn(sf))
+_FACTS: Dict[str, object] = {}
+# whole-program passes run by the runner over every file's cached facts
+# (name -> fn(facts_by_path) -> findings). Their findings are recomputed on
+# every run — never cached per file, since they depend on OTHER files.
+_GLOBAL: Dict[str, object] = {}
 
 
 def register(name: str):
@@ -27,19 +33,44 @@ def register(name: str):
     return deco
 
 
+def register_facts(name: str):
+    def deco(fn):
+        _FACTS[name] = fn
+        return fn
+
+    return deco
+
+
+def register_global(name: str):
+    def deco(fn):
+        _GLOBAL[name] = fn
+        return fn
+
+    return deco
+
+
 def RULE_NAMES() -> List[str]:
     _load_rules()
-    return sorted(_REGISTRY) + [META_RULE]
+    return sorted(set(_REGISTRY) | set(_GLOBAL)) + [META_RULE]
+
+
+_RULES_LOADED = False
 
 
 def _load_rules() -> None:
-    if _REGISTRY:
+    # a dedicated flag, NOT `if _REGISTRY:` — importing one rule module
+    # directly (tests do) pre-populates the registry, and the truthiness
+    # guard would then silently skip loading every other rule
+    global _RULES_LOADED
+    if _RULES_LOADED:
         return
+    _RULES_LOADED = True
     from dev.analysis import (  # noqa: F401
         rules_decline,
         rules_dtype,
         rules_failure,
         rules_guarded,
+        rules_lockorder,
         rules_readback,
         rules_routing,
         rules_tracer,
@@ -66,6 +97,12 @@ _DISABLE_RE = re.compile(r"disable=([\w.,-]+)(?:\s*--\s*(.*\S))?\s*$")
 _PATH_RE = re.compile(r"path=(\S+)")
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(\S[^#]*?)\s*$")
 _HOLDS_RE = re.compile(r"#\s*holds-lock:\s*(\S[^#]*?)\s*$")
+# check-then-act across a lock release, reviewed and accepted (ISSUE 14)
+_ATOMICITY_OK_RE = re.compile(r"#\s*atomicity-ok:\s*(\S[^#]*?)\s*$")
+# dynamic-dispatch seam (callback, plan-tree execute): the annotated def
+# may acquire the named canonical locks even though no call edge resolves
+# to them statically — feeds the lock-order graph (ISSUE 14)
+_MAY_ACQUIRE_RE = re.compile(r"#\s*may-acquire:\s*(\S[^#]*?)\s*$")
 
 
 @dataclasses.dataclass
@@ -92,6 +129,8 @@ class SourceFile:
         self.suppressions: List[Suppression] = []
         self.guarded: Dict[int, str] = {}  # line -> lock expr
         self.holds: Dict[int, str] = {}  # line -> lock expr
+        self.atomicity_ok: Dict[int, str] = {}  # line -> reason
+        self.may_acquire: Dict[int, str] = {}  # line -> lock list expr
         self.meta_findings: List[Finding] = []
         self.path = display_path
         self._scan_comments()
@@ -116,6 +155,14 @@ class SourceFile:
             h = _HOLDS_RE.search(text)
             if h:
                 self.holds[line] = h.group(1).strip()
+            a = _ATOMICITY_OK_RE.search(text)
+            if a:
+                # a standalone annotation covers the next line's statement
+                self.atomicity_ok[line if not standalone else line + 1] = \
+                    a.group(1).strip()
+            ma = _MAY_ACQUIRE_RE.search(text)
+            if ma:
+                self.may_acquire[line] = ma.group(1).strip()
             m = _DIRECTIVE_RE.search(text)
             if not m:
                 continue
@@ -163,13 +210,20 @@ class SourceFile:
 
     def holds_lock(self, func: ast.AST) -> Optional[str]:
         """Lock named by a `# holds-lock:` comment on the def's signature."""
+        return self._def_annotation(func, self.holds)
+
+    def may_acquire_of(self, func: ast.AST) -> Optional[str]:
+        """Lock list named by a `# may-acquire:` comment on the def."""
+        return self._def_annotation(func, self.may_acquire)
+
+    def _def_annotation(self, func: ast.AST, table: Dict[int, str]) -> Optional[str]:
         if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return None
         end = func.body[0].lineno if func.body else func.lineno + 1
         # lineno-1 covers a standalone annotation directly above the def
         for line in range(func.lineno - 1, end + 1):
-            if line in self.holds:
-                return self.holds[line]
+            if line in table:
+                return table[line]
         return None
 
     # -- suppression application -------------------------------------------
@@ -207,9 +261,11 @@ def _display_path(path: str) -> str:
     return os.path.relpath(ap, root) if ap.startswith(root + os.sep) else path
 
 
-def _analyze(path: str) -> Tuple[List[Finding], int]:
-    """(surviving findings, reasoned-suppression count) for one file —
-    one read/parse/tokenize pass serves both."""
+def _analyze(path: str) -> Tuple[List[Finding], int, dict]:
+    """(surviving findings, reasoned-suppression count, facts) for one
+    file — one read/parse/tokenize pass serves all three. Facts feed the
+    whole-program passes (lock-order graph) and are cached beside the
+    findings."""
     _load_rules()
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
@@ -217,19 +273,36 @@ def _analyze(path: str) -> Tuple[List[Finding], int]:
         sf = SourceFile(path, source, _display_path(path))
     except SyntaxError as e:
         return [Finding(META_RULE, _display_path(path), e.lineno or 1, 0,
-                        f"syntax error: {e.msg}")], 0
+                        f"syntax error: {e.msg}")], 0, {}
     findings: List[Finding] = []
     for name, check in sorted(_REGISTRY.items()):
         findings.extend(check(sf))
     findings = sf.apply_suppressions(findings)
     findings.extend(sf.meta_findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings, len(sf.suppressions)
+    facts = {name: fn(sf) for name, fn in sorted(_FACTS.items())}
+    return findings, len(sf.suppressions), facts
+
+
+def _global_findings(facts_by_path: Dict[str, dict]) -> List[Finding]:
+    """Run every whole-program pass over the collected per-file facts."""
+    _load_rules()
+    findings: List[Finding] = []
+    for name, fn in sorted(_GLOBAL.items()):
+        findings.extend(fn(facts_by_path))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
 
 
 def analyze_file(path: str) -> List[Finding]:
-    """All surviving findings for one file (suppressions applied)."""
-    return _analyze(path)[0]
+    """All surviving findings for one file (suppressions applied) —
+    including the whole-program passes scoped to just this file, so a
+    single-file CLI run (and the fixture pair tests) exercise the
+    lock-order graph checks."""
+    findings, _n, facts = _analyze(path)
+    findings = findings + _global_findings({_display_path(path): facts})
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
 
 
 def suppression_count(path: str) -> int:
@@ -243,12 +316,12 @@ CACHE_BASENAME = ".ballista_lint_cache.json"
 
 
 def _analyzer_hash() -> str:
-    """Hash of the analyzer's own sources: a rule change invalidates every
-    cached verdict."""
+    """Hash of the analyzer's own sources AND the lock-order manifest: a
+    rule or manifest change invalidates every cached verdict."""
     d = os.path.dirname(os.path.abspath(__file__))
     h = hashlib.sha1()
     for name in sorted(os.listdir(d)):
-        if name.endswith(".py"):
+        if name.endswith(".py") or name.endswith(".toml"):
             with open(os.path.join(d, name), "rb") as f:
                 h.update(name.encode())
                 h.update(f.read())
@@ -275,20 +348,26 @@ class FileCache:
         st = os.stat(path)
         return f"{st.st_mtime_ns}:{st.st_size}"
 
-    def get(self, path: str) -> Optional[Tuple[List[Finding], int]]:
+    def get(self, path: str) -> Optional[Tuple[List[Finding], int, dict]]:
         ap = os.path.abspath(path)
         ent = self.data.get(ap)
         if ent is None or ent.get("key") != self._key(path):
             return None
         self.hits += 1
-        return [Finding(**f) for f in ent["findings"]], ent.get("suppressions", 0)
+        return (
+            [Finding(**f) for f in ent["findings"]],
+            ent.get("suppressions", 0),
+            ent.get("facts", {}),
+        )
 
-    def put(self, path: str, findings: List[Finding], suppressions: int) -> None:
+    def put(self, path: str, findings: List[Finding], suppressions: int,
+            facts: dict) -> None:
         ap = os.path.abspath(path)
         self.data[ap] = {
             "key": self._key(path),
             "findings": [f.to_dict() for f in findings],
             "suppressions": suppressions,
+            "facts": facts,
         }
         self.dirty = True
 
@@ -323,27 +402,63 @@ def collect_py_files(paths: List[str]) -> List[str]:
     return out
 
 
+def _analyze_for_pool(path: str) -> Tuple[str, List[dict], int, dict]:
+    """Process-pool worker: one file, serialized findings (dicts pickle
+    smaller and version-stably across pool boundaries)."""
+    findings, n_supp, facts = _analyze(path)
+    return path, [f.to_dict() for f in findings], n_supp, facts
+
+
 def run_paths(paths: List[str], use_cache: bool = True,
-              cache_path: Optional[str] = None) -> Tuple[List[Finding], dict]:
-    """Analyze every .py under `paths`. Returns (findings, stats)."""
+              cache_path: Optional[str] = None,
+              jobs: int = 1) -> Tuple[List[Finding], dict]:
+    """Analyze every .py under `paths`. Returns (findings, stats).
+
+    `jobs` > 1 fans the per-file analysis over a process pool (ISSUE 14:
+    the strict lint gate stops being serial as rule count grows) with the
+    SAME cache semantics — cached files never hit the pool, fresh results
+    land in the cache identically — and a deterministic report order
+    (results are reassembled in file order regardless of completion
+    order). The whole-program lock-order pass then runs over every file's
+    facts, cached or fresh; its findings depend on OTHER files and are
+    recomputed each run, never cached."""
     _load_rules()
     files = collect_py_files(paths)
     if use_cache and cache_path is None:
         cache_path = os.path.join(_repo_root(), CACHE_BASENAME)
     cache = FileCache(cache_path if use_cache else None)
-    findings: List[Finding] = []
-    n_suppressions = 0
+    per_file: Dict[str, Tuple[List[Finding], int, dict]] = {}
+    fresh = []
     for path in files:
         cached = cache.get(path) if use_cache else None
         if cached is not None:
-            result, n_supp = cached
+            per_file[path] = cached
         else:
-            result, n_supp = _analyze(path)
-            if use_cache:
-                cache.put(path, result, n_supp)
+            fresh.append(path)
+    if fresh and jobs > 1:
+        import concurrent.futures
+
+        with concurrent.futures.ProcessPoolExecutor(max_workers=jobs) as ex:
+            for path, fdicts, n_supp, facts in ex.map(
+                _analyze_for_pool, fresh, chunksize=4
+            ):
+                per_file[path] = ([Finding(**d) for d in fdicts], n_supp, facts)
+    else:
+        for path in fresh:
+            per_file[path] = _analyze(path)
+    findings: List[Finding] = []
+    n_suppressions = 0
+    facts_by_path: Dict[str, dict] = {}
+    fresh_set = set(fresh)
+    for path in files:
+        result, n_supp, facts = per_file[path]
+        if use_cache and path in fresh_set:
+            cache.put(path, result, n_supp, facts)
         findings.extend(result)
         n_suppressions += n_supp
+        facts_by_path[_display_path(path)] = facts
     cache.save()
+    findings.extend(_global_findings(facts_by_path))
     stats = {
         "files": len(files),
         "cache_hits": cache.hits,
@@ -351,3 +466,26 @@ def run_paths(paths: List[str], use_cache: bool = True,
         "findings": len(findings),
     }
     return findings, stats
+
+
+def collect_facts(paths: List[str], use_cache: bool = True,
+                  cache_path: Optional[str] = None) -> Dict[str, dict]:
+    """Per-file facts for every .py under `paths` (display path -> facts)
+    — the static side of the witness cross-check."""
+    _load_rules()
+    files = collect_py_files(paths)
+    if use_cache and cache_path is None:
+        cache_path = os.path.join(_repo_root(), CACHE_BASENAME)
+    cache = FileCache(cache_path if use_cache else None)
+    out: Dict[str, dict] = {}
+    for path in files:
+        cached = cache.get(path) if use_cache else None
+        if cached is not None:
+            out[_display_path(path)] = cached[2]
+        else:
+            findings, n_supp, facts = _analyze(path)
+            if use_cache:
+                cache.put(path, findings, n_supp, facts)
+            out[_display_path(path)] = facts
+    cache.save()
+    return out

@@ -1,9 +1,9 @@
 # ballista-lint: path=ballista_tpu/ops/fixture_guarded_good.py
 """GOOD: every touch under the lock (or inside a holds-lock helper whose
 callers hold it); __init__ registration is exempt."""
-import threading
+from ballista_tpu.utils.locks import make_lock
 
-_lock = threading.Lock()
+_lock = make_lock("ops.fixture_guarded_good._lock")
 _totals = {"rows": 0}  # guarded-by: _lock
 
 
@@ -24,7 +24,7 @@ def bump_via_helper(n):
 
 class Registry:
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = make_lock("ops.fixture_guarded_good._mu")
         self._entries = []  # guarded-by: self._mu
 
     def add(self, x):

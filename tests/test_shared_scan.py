@@ -454,3 +454,78 @@ def test_fuzz_concurrent_shared_scan(tmp_path, seed):
     batched = _run_concurrent(path, queries)
     for q, got, want in zip(queries, batched, solo):
         assert got == want, (q, got, want)
+
+
+# -- weighted fair-share sibling ordering (ISSUE 14 satellite) ---------------
+
+def test_form_shared_batch_fair_share_sibling_order():
+    """PR 13 residue: sibling selection must honor the same smallest
+    in_flight/weight fair-share key assignment uses — a heavy tenant with
+    many co-pending compatible stages can no longer fill every sibling
+    slot of a batch while a lighter tenant has compatible work. Pre-fix,
+    candidates were visited in KV insertion order, so the heavy tenant's
+    (earlier-submitted) jobs consumed all max_batch-1 slots."""
+    from ballista_tpu.proto import ballista_pb2 as pb
+    from ballista_tpu.scheduler.kv import MemoryBackend
+    from ballista_tpu.scheduler.state import SchedulerState
+
+    state = SchedulerState(
+        MemoryBackend(), "fairshare",
+        config=BallistaConfig({
+            "ballista.shared_scan.max_batch": "4",  # 3 sibling slots
+            "ballista.tpu.cost_model_dir": "",
+        }),
+    )
+
+    def add_job(job_id, tenant):
+        running = pb.JobStatus()
+        running.running.SetInParent()
+        state.save_job_metadata(job_id, running)
+        state.save_job_tenant(job_id, tenant, 0)
+        st = pb.TaskStatus()
+        st.partition_id.job_id = job_id
+        st.partition_id.stage_id = 1
+        st.partition_id.partition_id = 0
+        state.save_task_status(st)
+
+    # the heavy tenant submits FIRST (insertion order favored it pre-fix)
+    # and already has 4 running tasks in flight; the light tenant has none
+    for j in ("h1", "h2", "h3", "h4"):
+        add_job(j, "heavy")
+    add_job("l1", "light")
+    for i in range(4):
+        run = pb.TaskStatus()
+        run.partition_id.job_id = "h-running"
+        run.partition_id.stage_id = 9
+        run.partition_id.partition_id = i
+        run.running.executor_id = "e-other"
+        state.save_task_status(run)
+    state.save_job_tenant("h-running", "heavy", 0)
+    rj = pb.JobStatus()
+    rj.running.SetInParent()
+    state.save_job_metadata("h-running", rj)
+
+    # primary already assigned (another heavy job)
+    primary = pb.TaskStatus()
+    primary.partition_id.job_id = "h0"
+    primary.partition_id.stage_id = 1
+    primary.partition_id.partition_id = 0
+    primary.running.executor_id = "e1"
+    state.save_job_tenant("h0", "heavy", 0)
+    pj = pb.JobStatus()
+    pj.running.SetInParent()
+    state.save_job_metadata("h0", pj)
+
+    # unit harness: every candidate stage is scan-compatible and binds
+    sig = ("ParquetScanExec", ("f.parquet",), False, 1)
+    state._cached_stage_signature = lambda j, s: sig
+    state._bound_stage_plan = lambda j, s, idx: object()
+
+    out = state.form_shared_batch(primary, object(), "e1")
+    members = [st.partition_id.job_id for st, _plan in out]
+    assert len(members) == 3
+    # the light tenant's job MUST hold a slot (pre-fix: ['h1','h2','h3'])
+    assert "l1" in members, members
+    # and the re-ranking interleaves rather than draining one tenant:
+    # light (0 in flight) first, then heavy's fair share
+    assert members[0] == "l1", members

@@ -52,7 +52,39 @@ def test_all_rules_registered():
     names = RULE_NAMES()
     for r in RULES:
         assert r in names
+    assert "lock-order" in names  # ISSUE 14
     assert "lint-usage" in names
+
+
+# -- lock-order (ISSUE 14) ---------------------------------------------------
+
+def test_lockorder_fixture_pair():
+    """ISSUE 14: an undeclared nesting acquired in both orders (a cycle),
+    a raw unwitnessable threading.Lock, a missing annotation, and a lying
+    make_lock literal all fail lint; the canonical shapes (declared
+    forward nesting, holds-lock helper, double-checked insert, annotated
+    check-then-act) are clean."""
+    findings = [
+        f.message for f in analyze_file(str(FIXTURES / "lockorder_bad.py"))
+        if f.rule == "lock-order"
+    ]
+    assert any("undeclared lock-order edge" in m for m in findings), findings
+    assert any("potential deadlock: lock-order cycle" in m for m in findings)
+    assert any("raw threading.Lock()" in m for m in findings)
+    assert any("no guarded-by:/holds-lock: annotation" in m for m in findings)
+    assert any("does not match its canonical identity" in m for m in findings)
+    good = analyze_file(str(FIXTURES / "lockorder_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
+def test_atomicity_fixture_flagged():
+    """ISSUE 14: a read-modify-write of guarded state spanning two
+    acquisitions (check-then-act across a release) fails lint."""
+    findings = [
+        f.message for f in analyze_file(str(FIXTURES / "atomicity_bad.py"))
+        if f.rule == "lock-order"
+    ]
+    assert any("check-then-act across a release" in m for m in findings)
 
 
 # -- per-rule fixtures -------------------------------------------------------
