@@ -386,14 +386,48 @@ class BallistaContext(ExecutionContext):
         finally:
             source.close()
 
+    def _storage_read_table(self, loc: pb.PartitionLocation):
+        """Direct shared-storage read of a storage-homed result partition
+        (ISSUE 15), or None to use the Flight ladder — the client fetches
+        the bytes from the mount instead of round-tripping them through the
+        (possibly already retired) producing executor. Confined to this
+        client's OWN configured ballista.shuffle.dir: the location path
+        came from the scheduler and must not name arbitrary local files.
+        Any read failure falls back to Flight, never errors here."""
+        if not loc.storage_uri:
+            return None
+        root = self.config.shuffle_dir()
+        if not root:
+            return None
+        from ballista_tpu.executor.confine import resolve_contained
+        from ballista_tpu.ops.runtime import record_shuffle_tier
+
+        resolved = resolve_contained(os.path.join(loc.path, "0.arrow"), root)
+        if resolved is None or not os.path.exists(resolved):
+            record_shuffle_tier("client_storage_miss")
+            return None
+        try:
+            with pa.ipc.open_file(resolved) as r:
+                table = r.read_all()
+        except Exception:
+            record_shuffle_tier("client_storage_miss")
+            return None
+        record_shuffle_tier("client_storage_fetch")
+        return table
+
     def _fetch_partition_batches(self, loc: pb.PartitionLocation) -> list:
-        """One result partition as a committed batch list, streamed over
-        Flight (client/flight.py stream_action). Any failure — connect,
-        first byte, or mid-stream — surfaces as ShuffleFetchError naming
-        the lost location; partial batches are dropped by the caller."""
+        """One result partition as a committed batch list — read straight
+        from shared storage when the location is storage-homed (ISSUE 15),
+        else streamed over Flight (client/flight.py stream_action). Any
+        Flight failure — connect, first byte, or mid-stream — surfaces as
+        ShuffleFetchError naming the lost location; partial batches are
+        dropped by the caller."""
         from ballista_tpu.client.flight import BallistaClient
         from ballista_tpu.errors import RpcError, ShuffleFetchError
 
+        table = self._storage_read_table(loc)
+        if table is not None:
+            return table.to_batches()
         action = pb.Action()
         action.fetch_partition.path = os.path.join(loc.path, "0.arrow")
         try:
@@ -514,6 +548,11 @@ class BallistaContext(ExecutionContext):
         from ballista_tpu.client.flight import BallistaClient
         from ballista_tpu.errors import RpcError, ShuffleFetchError
 
+        # storage-homed result partitions read straight from the shared
+        # mount (ISSUE 15); Flight stays the fallback transport
+        table = self._storage_read_table(loc)
+        if table is not None:
+            return table
         try:
             client = BallistaClient(
                 loc.executor_meta.host,

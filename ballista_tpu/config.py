@@ -22,6 +22,37 @@ BALLISTA_MESH_SHAPE = "ballista.tpu.mesh"  # e.g. "data:8" or "data:4,model:2"
 BALLISTA_SHUFFLE_PARTITIONS = "ballista.shuffle.partitions"
 # compression for materialized shuffle pieces: "" (none) | "zstd" | "lz4"
 BALLISTA_SHUFFLE_CODEC = "ballista.shuffle.codec"
+# -- disaggregated shuffle tier (ISSUE 15) ----------------------------------
+# where materialized shuffle pieces live:
+#   "local"  — the producing executor's private work dir, served to peers
+#              over Flight (the reference design; executor death loses the
+#              pieces and lineage recompute recovers them)
+#   "shared" — a shared-storage directory rooted at ballista.shuffle.dir
+#              (NFS/fuse mount, or any path every node sees). A piece's
+#              home becomes a PATH, not a process: executor death after map
+#              completion is a non-event (no lineage recompute, no task
+#              retries), and scaling the fleet in destroys no data.
+# Readers resolve storage-homed pieces from the shared dir first; the
+# Flight peer fetch stays as the local-tier path and the fallback ladder.
+BALLISTA_SHUFFLE_TIER = "ballista.shuffle.tier"
+BALLISTA_SHUFFLE_DIR = "ballista.shuffle.dir"
+# -- elastic executor fleet (ISSUE 15, executor/runtime.py) -----------------
+# StandaloneCluster autoscaler: grows/shrinks the executor fleet against
+# the admission queue's cost-model-predicted backlog seconds. max = 0
+# disables autoscaling entirely (the fixed-fleet default); with max > 0
+# the fleet floats in [min, max] — scale-OUT adds executors while the
+# predicted backlog exceeds target_backlog_s, scale-IN gracefully drains
+# one executor per evaluation (stop offering slots, finish running tasks,
+# retire) once the cluster is idle. On the shared shuffle tier a drain
+# destroys no data, so scale-in completes running jobs with zero retries.
+BALLISTA_FLEET_MIN = "ballista.fleet.min"
+BALLISTA_FLEET_MAX = "ballista.fleet.max"
+BALLISTA_FLEET_INTERVAL_S = "ballista.fleet.interval_s"
+# predicted backlog seconds one evaluation tolerates before growing the
+# fleet; also the growth denominator (desired extra executors ~= backlog /
+# target), so a deep queue grows the fleet in one evaluation, not one
+# executor per tick
+BALLISTA_FLEET_TARGET_BACKLOG_S = "ballista.fleet.target_backlog_s"
 BALLISTA_DEVICE_CACHE = "ballista.tpu.device_cache"  # keep encoded columns resident in HBM
 # total bytes of cached device residency across stages; partitions beyond
 # the budget stream (upload, compute, free) instead of pinning — how SF=100
@@ -141,6 +172,12 @@ BALLISTA_SPECULATION_MULTIPLIER = "ballista.speculation.multiplier"
 # duplicate could help; this is also why fault-free runs launch nothing
 # under the defaults)
 BALLISTA_SPECULATION_MIN_RUNTIME_MS = "ballista.speculation.min_runtime_ms"
+# re-speculation bound (ISSUE 15 satellite, PR 11 residue): how many
+# speculative duplicates one task may accumulate. A duplicate that ITSELF
+# straggles past the same cost-model threshold may be re-speculated
+# (superseding the straggling duplicate in the ledger) until this many
+# have launched; 1 restores the old launch-once behavior.
+BALLISTA_SPECULATION_MAX_ATTEMPTS = "ballista.speculation.max_attempts"
 # -- shared-scan multi-query execution (ISSUE 13) ---------------------------
 # scheduler-side scan sharing: concurrent DISTINCT jobs whose pending
 # fused-aggregate stages read the same persisted layout (same scan files,
@@ -235,6 +272,15 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_MESH_SHAPE: "data:1",
     BALLISTA_SHUFFLE_PARTITIONS: "16",
     BALLISTA_SHUFFLE_CODEC: "",
+    # local tier = the reference design (peer-served work-dir pieces);
+    # "shared" requires ballista.shuffle.dir to name the storage root
+    BALLISTA_SHUFFLE_TIER: "local",
+    BALLISTA_SHUFFLE_DIR: "",
+    # autoscaling off by default: a fixed fleet behaves exactly as before
+    BALLISTA_FLEET_MIN: "1",
+    BALLISTA_FLEET_MAX: "0",
+    BALLISTA_FLEET_INTERVAL_S: "0.5",
+    BALLISTA_FLEET_TARGET_BACKLOG_S: "1.0",
     BALLISTA_DEVICE_CACHE: "true",
     BALLISTA_TPU_HBM_BUDGET: str(12 << 30),
     BALLISTA_SCAN_CACHE: "true",
@@ -297,6 +343,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_SPECULATION: "true",
     BALLISTA_SPECULATION_MULTIPLIER: "4",
     BALLISTA_SPECULATION_MIN_RUNTIME_MS: "500",
+    BALLISTA_SPECULATION_MAX_ATTEMPTS: "2",
     BALLISTA_PUSH_STATUS: "true",
     # shared-scan batching defaults ON: a batch is only formed from
     # co-pending compatible stages, degrades to solo on any doubt, and is
@@ -345,6 +392,56 @@ class BallistaConfig(Mapping[str, str]):
 
     def shuffle_partitions(self) -> int:
         return int(self._settings[BALLISTA_SHUFFLE_PARTITIONS])
+
+    def shuffle_tier(self) -> str:
+        """Where shuffle pieces live: "local" (executor work dirs, peer-
+        served over Flight) or "shared" (the disaggregated storage tier,
+        ISSUE 15)."""
+        t = self._settings[BALLISTA_SHUFFLE_TIER].strip().lower()
+        if t not in ("local", "shared"):
+            raise ValueError(f"unknown shuffle tier {t!r} (local|shared)")
+        return t
+
+    def shuffle_dir(self) -> str:
+        """Expanded shared-storage root for the "shared" shuffle tier;
+        "" = unset (required when the tier is shared)."""
+        import os
+
+        d = self._settings[BALLISTA_SHUFFLE_DIR].strip()
+        return os.path.expanduser(d) if d else ""
+
+    def shuffle_storage_root(self) -> str:
+        """The shared-storage root when the shared tier is ACTIVE, else "".
+        The one check writers/readers consult: a shared tier without a
+        configured directory is a misconfiguration and raises here (never
+        silently degrades to local — the operator asked for durability)."""
+        if self.shuffle_tier() != "shared":
+            return ""
+        d = self.shuffle_dir()
+        if not d:
+            raise ValueError(
+                "ballista.shuffle.tier=shared requires ballista.shuffle.dir"
+            )
+        return d
+
+    def fleet_min(self) -> int:
+        """Autoscaler floor (ISSUE 15): the fleet never drains below this."""
+        return max(1, int(self._settings[BALLISTA_FLEET_MIN]))
+
+    def fleet_max(self) -> int:
+        """Autoscaler ceiling; 0 disables autoscaling (fixed fleet)."""
+        return max(0, int(self._settings[BALLISTA_FLEET_MAX]))
+
+    def fleet_interval_s(self) -> float:
+        """Seconds between autoscaler evaluations."""
+        return max(0.05, float(self._settings[BALLISTA_FLEET_INTERVAL_S]))
+
+    def fleet_target_backlog_s(self) -> float:
+        """Predicted backlog seconds one evaluation tolerates before the
+        fleet grows (also the growth denominator)."""
+        return max(
+            1e-3, float(self._settings[BALLISTA_FLEET_TARGET_BACKLOG_S])
+        )
 
     def device_cache(self) -> bool:
         return self._settings[BALLISTA_DEVICE_CACHE].lower() in ("1", "true", "yes")
@@ -469,6 +566,12 @@ class BallistaConfig(Mapping[str, str]):
         return max(
             0.0, float(self._settings[BALLISTA_SPECULATION_MIN_RUNTIME_MS])
         ) / 1000.0
+
+    def speculation_max_attempts(self) -> int:
+        """Most speculative duplicates one task may accumulate (ISSUE 15
+        satellite): past the first, only a duplicate that itself straggles
+        earns a successor. Minimum 1 (the launch-once behavior)."""
+        return max(1, int(self._settings[BALLISTA_SPECULATION_MAX_ATTEMPTS]))
 
     def shared_scan(self) -> bool:
         """Shared-scan multi-query batching (ISSUE 13): concurrent jobs'

@@ -41,6 +41,11 @@ EXECUTOR_SPEC: List[Tuple[str, Any, str]] = [
     ("concurrent_tasks", 4, "max concurrent tasks"),
     ("backend", "cpu", "kernel backend: cpu | tpu"),
     ("data_roots", "", "comma-separated dirs wire-plan scans may read ('' = any)"),
+    # disaggregated shuffle tier (ISSUE 15): 'shared' publishes pieces to
+    # shuffle_dir (a mount every node sees) instead of the private work
+    # dir, so executor loss/retirement destroys no shuffle data
+    ("shuffle_tier", "local", "shuffle piece home: local | shared"),
+    ("shuffle_dir", "", "shared-storage root for the shared shuffle tier"),
 ]
 
 

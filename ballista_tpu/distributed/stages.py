@@ -11,12 +11,21 @@ Shuffle file layout under an executor's work dir:
     {work_dir}/{job_id}/{stage_id}/{input_partition}/{output_partition}.arrow
 CompletedTask.path points at the {input_partition} directory; readers derive
 piece paths from it (ref flight_service.rs:104-126 wrote a single data.arrow).
+
+Disaggregated shuffle tier (ISSUE 15): with ballista.shuffle.tier=shared the
+SAME layout roots at ballista.shuffle.dir instead of the executor's private
+work dir, published with the same atomic tmp-then-os.replace discipline. A
+piece's home is then a path, not a process — CompletedTask/PartitionLocation
+carry it as `storage_uri` — so executor death after map completion loses
+nothing, and readers resolve storage-homed pieces from the shared dir FIRST,
+with the Flight peer fetch as the local-tier path and the fallback ladder
+(storage read -> peer fetch -> fetch_failed/lineage recompute).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Callable, Iterator, List, Optional
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import pyarrow as pa
 import pyarrow.ipc
@@ -67,10 +76,14 @@ def _piece_tmp_path(path: str) -> str:
 
 def write_stream_to_disk(
     batches: Iterator[pa.RecordBatch], schema: pa.Schema, path: str,
-    codec: Optional[str] = None,
+    codec: Optional[str] = None, pre_publish=None,
 ) -> PartitionStats:
     """Arrow IPC file writer with stats (ref utils.rs write_stream_to_disk).
-    Writes to a temp name and atomically publishes on success."""
+    Writes to a temp name and atomically publishes on success. `pre_publish`
+    (shared tier, ISSUE 15) runs after the temp file closed clean and before
+    the os.replace — a raise there is a TORN write: the temp is discarded
+    and nothing was published, exactly the failure the shuffle.store chaos
+    site rehearses."""
     stats = PartitionStats()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     tmp = _piece_tmp_path(path)
@@ -81,11 +94,32 @@ def write_stream_to_disk(
                 stats.num_rows += b.num_rows
                 stats.num_batches += 1
                 stats.num_bytes += b.nbytes
+        if pre_publish is not None:
+            pre_publish()
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
     return stats
+
+
+def shuffle_output_base(
+    ctx: TaskContext, job_id: str, stage_id: int, partition: int
+) -> Tuple[str, str]:
+    """(piece-set base dir, storage_uri) for one map task's output.
+
+    Shared tier: the base roots at ballista.shuffle.dir and doubles as the
+    storage_uri — the location's home is the path itself, so any node with
+    the mount resolves the pieces without the producing executor. Local
+    tier: the executor's private work dir, storage_uri empty (peers fetch
+    over Flight, the reference design)."""
+    root = ctx.config.shuffle_storage_root()
+    if root:
+        base = os.path.join(root, job_id, str(stage_id), str(partition))
+        return base, base
+    if ctx.work_dir is None:
+        raise ExecutionError("shuffle write requires a work_dir")
+    return os.path.join(ctx.work_dir, job_id, str(stage_id), str(partition)), ""
 
 
 def read_ipc_file(path: str) -> Iterator[pa.RecordBatch]:
@@ -132,22 +166,58 @@ class ShuffleWriterExec(ExecutionPlan):
         return self.shuffle_output_partitioning.partition_count()
 
     # ------------------------------------------------------------------
+    def _storage_publish_chaos(self, partition: int, ctx: TaskContext):
+        """Pre-publish hook for the shared tier: a `shuffle.store` write
+        verdict (keyed on plan coordinates + attempt, so the retried
+        attempt draws fresh) raises AFTER the temp pieces closed clean and
+        BEFORE any os.replace — a torn publish that leaves nothing visible.
+        None on the local tier (the site is about the storage tier)."""
+        from ballista_tpu.utils.chaos import chaos_from_config
+
+        chaos = chaos_from_config(ctx.config)
+        if chaos is None:
+            return None
+
+        def pre_publish() -> None:
+            from ballista_tpu.ops.runtime import record_shuffle_tier
+            from ballista_tpu.utils.chaos import ChaosInjected
+
+            try:
+                chaos.maybe_fail(
+                    "shuffle.store",
+                    f"w{self.stage_id}/{partition}@a{ctx.attempt}",
+                )
+            except ChaosInjected:
+                record_shuffle_tier("storage_publish_torn")
+                raise
+
+        return pre_publish
+
     def execute_shuffle_write(self, partition: int, ctx: TaskContext) -> PartitionStats:
         """Run the child partition and write the split pieces; returns
-        aggregate stats. Piece paths: {work_dir}/{job}/{stage}/{partition}/{m}.arrow"""
-        if ctx.work_dir is None:
-            raise ExecutionError("shuffle write requires a work_dir")
-        base = os.path.join(
-            ctx.work_dir, self.job_id, str(self.stage_id), str(partition)
+        aggregate stats. Piece paths: {base}/{m}.arrow with {base} from
+        shuffle_output_base — the executor work dir (local tier) or the
+        shared storage dir (shared tier, same atomic publish)."""
+        from ballista_tpu.ops.runtime import record_shuffle_tier
+
+        base, storage_uri = shuffle_output_base(
+            ctx, self.job_id, self.stage_id, partition
         )
         schema = self.schema()
         pscheme = self.shuffle_output_partitioning
         total = PartitionStats()
         codec = ctx.config.shuffle_codec()
+        pre_publish = (
+            self._storage_publish_chaos(partition, ctx) if storage_uri else None
+        )
         if pscheme is None:
             stats = write_stream_to_disk(
                 self.input.execute(partition, ctx), schema,
                 os.path.join(base, "0.arrow"), codec=codec,
+                pre_publish=pre_publish,
+            )
+            record_shuffle_tier(
+                "storage_publish" if storage_uri else "local_publish"
             )
             return stats
         n_out = pscheme.partition_count()
@@ -180,6 +250,10 @@ class ShuffleWriterExec(ExecutionPlan):
                         total.num_rows += piece.num_rows
                         total.num_bytes += piece.nbytes
                 total.num_batches += 1
+            if pre_publish is not None:
+                # shared-tier torn-write seam: raising here leaves ok=False,
+                # so every temp piece is discarded and nothing publishes
+                pre_publish()
             ok = True
         finally:
             for sink, w in writers:
@@ -195,13 +269,17 @@ class ShuffleWriterExec(ExecutionPlan):
                 for tmp in tmps:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
+        if ok:
+            record_shuffle_tier(
+                "storage_publish" if storage_uri else "local_publish"
+            )
         return total
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
         # in-process fallback: write then read back the pieces concatenated
         self.execute_shuffle_write(partition, ctx)
-        base = os.path.join(
-            ctx.work_dir, self.job_id, str(self.stage_id), str(partition)
+        base, _storage = shuffle_output_base(
+            ctx, self.job_id, self.stage_id, partition
         )
         for name in sorted(os.listdir(base)):
             # only PUBLISHED pieces: a concurrent duplicate execution's
@@ -220,7 +298,12 @@ class ShuffleLocation:
     """Where one completed map task's output lives. stage_id/map_partition
     name the producing map task (lineage): a reduce task that fails to fetch
     from here reports them in its fetch_failed status so the scheduler can
-    recompute exactly that map partition."""
+    recompute exactly that map partition.
+
+    storage_uri (ISSUE 15): non-empty when the piece set lives in the
+    SHARED storage tier — the home is then the path itself, readers resolve
+    it from the mount first, and the executor coordinates degrade to a
+    fallback transport rather than the data's single point of failure."""
 
     def __init__(
         self,
@@ -230,6 +313,7 @@ class ShuffleLocation:
         path: str,
         stage_id: int = 0,
         map_partition: int = 0,
+        storage_uri: str = "",
     ) -> None:
         self.executor_id = executor_id
         self.host = host
@@ -237,11 +321,13 @@ class ShuffleLocation:
         self.path = path  # base dir containing {m}.arrow pieces
         self.stage_id = stage_id
         self.map_partition = map_partition
+        self.storage_uri = storage_uri
 
     def __repr__(self) -> str:
+        home = f", storage={self.storage_uri}" if self.storage_uri else ""
         return (
             f"ShuffleLocation({self.executor_id}@{self.host}:{self.port}, "
-            f"{self.path}, map={self.stage_id}/{self.map_partition})"
+            f"{self.path}, map={self.stage_id}/{self.map_partition}{home})"
         )
 
 
@@ -333,10 +419,56 @@ class ShuffleReaderExec(ExecutionPlan):
                     stage_id=loc.stage_id,
                     map_partition=loc.map_partition,
                 ) from e
+        if loc.storage_uri:
+            # disaggregated tier (ISSUE 15): the piece's home is a PATH —
+            # resolve it from the shared mount first. A shuffle.store READ
+            # verdict (keyed like flight.fetch on plan coordinates + the
+            # consuming attempt) makes the published piece unreadable for
+            # this attempt, exercising the fallback ladder: Flight peer
+            # fetch below, then fetch_failed -> lineage recompute — the
+            # recomputed map republishes and the requeued consumer's fresh
+            # attempt draws a fresh verdict.
+            from ballista_tpu.ops.runtime import (
+                record_recovery,
+                record_shuffle_tier,
+            )
+
+            torn = chaos is not None and chaos.should_inject(
+                "shuffle.store",
+                f"r{loc.stage_id}/{loc.map_partition}/piece{piece_idx}"
+                f"@a{ctx.attempt}",
+            )
+            if torn:
+                record_recovery("chaos_injected")
+                record_shuffle_tier("storage_read_torn")
+            else:
+                resolved = self._storage_read_path(piece, ctx)
+                if resolved is not None and os.path.exists(resolved):
+                    record_shuffle_tier("storage_fetch")
+                    yield from read_ipc_file(resolved)
+                    return
+            record_shuffle_tier("storage_fallback_peer")
+            if not loc.host or not loc.port:
+                # no live peer to fall back to (the producing executor is
+                # gone and its metadata never bound): the piece is LOST for
+                # this attempt — name it so lineage recomputes exactly it
+                raise ShuffleFetchError(
+                    f"storage-homed shuffle piece {piece} unreadable and "
+                    f"no peer fallback (producer {loc.executor_id} gone)",
+                    executor_id=loc.executor_id,
+                    host=loc.host,
+                    port=loc.port,
+                    path=loc.path,
+                    stage_id=loc.stage_id,
+                    map_partition=loc.map_partition,
+                )
         resolved = self._local_read_path(piece, ctx)
         if resolved is not None and os.path.exists(resolved):
             yield from read_ipc_file(resolved)
         elif ctx.shuffle_fetcher is not None:
+            from ballista_tpu.ops.runtime import record_shuffle_tier
+
+            record_shuffle_tier("peer_fetch")
             try:
                 yield from ctx.shuffle_fetcher(loc, piece_idx)
             except ShuffleFetchError:
@@ -359,6 +491,22 @@ class ShuffleReaderExec(ExecutionPlan):
             raise ExecutionError(
                 f"shuffle piece not found locally and no fetcher: {piece}"
             )
+
+    @staticmethod
+    def _storage_read_path(piece: str, ctx: TaskContext):
+        """Resolved shared-storage path for a storage-homed piece, or None
+        when this reader has no storage access (no ballista.shuffle.dir —
+        e.g. a local-tier consumer handed a storage-homed location by a
+        mixed deployment; the Flight fallback still works). Confined to the
+        READER'S OWN configured storage root, exactly like the work-dir
+        shortcut: the location path arrived over the wire and must not be
+        able to name arbitrary host files."""
+        from ballista_tpu.executor.confine import resolve_contained
+
+        root = ctx.config.shuffle_dir()
+        if not root:
+            return None
+        return resolve_contained(piece, root)
 
     @staticmethod
     def _local_read_path(piece: str, ctx: TaskContext):

@@ -48,6 +48,13 @@ def main() -> None:
             {
                 "ballista.executor.backend": cfg["backend"],
                 "ballista.executor.data_roots": cfg["data_roots"],
+                # disaggregated tier (ISSUE 15): a daemon-configured tier
+                # is PINNED — per-job settings cannot redirect shuffle
+                # writes/reads elsewhere (execution_loop re-pins both keys,
+                # and the Flight data plane always uses this config) — and
+                # the daemon's GC sweep owns this root's TTL
+                "ballista.shuffle.tier": cfg["shuffle_tier"],
+                "ballista.shuffle.dir": cfg["shuffle_dir"],
             }
         ),
     )

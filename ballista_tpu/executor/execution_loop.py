@@ -98,6 +98,10 @@ class PollLoop:
         # (job, stage, part) -> (PartitionId, attempt)
         # guarded-by: self._inflight_mu
         self._inflight: dict = {}
+        # statuses popped from _finished by a poll whose RPC is still in
+        # flight: a failed delivery requeues them, so drain() must not
+        # declare the executor empty while any are outstanding
+        self._delivering = 0  # guarded-by: self._inflight_mu
         # -- push dispatch (ISSUE 8) ------------------------------------
         self._push_enabled = self.config.push_dispatch()
         self._idle_poll_max = self.config.idle_poll_max_s()
@@ -112,6 +116,11 @@ class PollLoop:
         # dropped stream must start fallback polling NOW — the backoff only
         # ever delays true idle heartbeats
         self._wake = threading.Event()
+        # graceful scale-in (ISSUE 15): once set, this executor stops
+        # offering slots (polls become pure heartbeats, the push stream is
+        # cancelled and never re-opened) but keeps running — and reporting
+        # — its in-flight tasks until they drain. drain() waits for that.
+        self._draining = threading.Event()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -136,6 +145,54 @@ class PollLoop:
                 call.cancel()
             except Exception:
                 pass
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Graceful scale-in (ISSUE 15): stop accepting work, finish — and
+        REPORT — every in-flight task, then return True. The poll loop
+        keeps heartbeating throughout (statuses ride it; the lease stays
+        fresh, so no recovery machinery fires on a draining executor), and
+        the push stream is cancelled so the scheduler's pump stops
+        offering credit here. Returns False when in-flight work outlives
+        `timeout` — the caller decides whether to stop anyway (which would
+        reintroduce the recovery path drain exists to avoid)."""
+        from ballista_tpu.ops.runtime import record_fleet
+
+        self._draining.set()
+        self._cancel_push()
+        self._wake.set()
+        deadline = time.time() + timeout
+        while time.time() < deadline and not self._stop.is_set():
+            # one atomic read: pops out of _finished happen only inside
+            # _drain_statuses' _inflight_mu section, so under the same
+            # lock an undelivered status is in the queue OR in-delivery
+            with self._inflight_mu:
+                busy = (
+                    bool(self._inflight)
+                    or self._delivering > 0
+                    or not self._finished.empty()
+                )
+            if not busy:
+                # one synchronous flush: a racing heartbeat that failed
+                # mid-delivery requeues its statuses — drain must not
+                # declare victory while any are still undelivered
+                try:
+                    self.poll_once()
+                except Exception:
+                    pass
+                with self._inflight_mu:
+                    clean = (
+                        self._delivering == 0 and self._finished.empty()
+                    )
+                if clean:
+                    record_fleet("drain_completed")
+                    return True
+                continue
+            # a finished task's status must leave on the NEXT poll, not a
+            # decayed heartbeat
+            self._wake.set()
+            time.sleep(0.05)
+        record_fleet("drain_timeout")
+        return False
 
     def stop(self) -> None:
         self._stop.set()
@@ -209,33 +266,52 @@ class PollLoop:
                     self._poll_interval = POLL_INTERVAL_SECS
 
     def gc_work_dir(self) -> int:
-        """Delete shuffle dirs for jobs idle longer than shuffle_ttl_seconds."""
+        """Delete shuffle job dirs idle longer than shuffle_ttl_seconds —
+        in the private work dir AND (ISSUE 15) in this executor's
+        configured shared storage root, which would otherwise grow without
+        bound (a retired producer's pieces have no other owner). Every
+        executor on the mount runs the same sweep; racing rmtrees of an
+        expired dir are harmless (ignore_errors), and the TTL keeps live
+        jobs' pieces far out of reach."""
         import shutil
 
         removed = 0
         cutoff = time.time() - self.shuffle_ttl_seconds
-        if not os.path.isdir(self.work_dir):
-            return 0
-        for job_dir in os.listdir(self.work_dir):
-            path = os.path.join(self.work_dir, job_dir)
-            try:
-                if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
-                    shutil.rmtree(path, ignore_errors=True)
-                    removed += 1
-            except OSError:
+        roots = [self.work_dir]
+        storage = self.config.shuffle_dir()
+        if storage:
+            roots.append(storage)
+        for root in roots:
+            if not os.path.isdir(root):
                 continue
+            for job_dir in os.listdir(root):
+                path = os.path.join(root, job_dir)
+                try:
+                    if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
+                        shutil.rmtree(path, ignore_errors=True)
+                        removed += 1
+                except OSError:
+                    continue
         if removed:
             log.info("gc: removed %d expired job dirs", removed)
         return removed
 
     # ------------------------------------------------------------------
     def _drain_statuses(self):
-        out = []
-        while True:
-            try:
-                out.append(self._finished.get_nowait())
-            except queue.Empty:
-                return out
+        """Pop every finished status AND count it in-delivery, atomically
+        under _inflight_mu: drain() reads the queue and the _delivering
+        counter under the same lock, so an undelivered status is ALWAYS
+        visible to it — in the queue, or counted — with no window between
+        the pop and the count."""
+        with self._inflight_mu:
+            out = []
+            while True:
+                try:
+                    out.append(self._finished.get_nowait())
+                except queue.Empty:
+                    break
+            self._delivering += len(out)
+            return out
 
     def poll_once(self) -> bool:
         """One PollWork round; returns True if a task was received.
@@ -254,7 +330,7 @@ class PollLoop:
         work again — that IS the fallback."""
         slot_held = (
             False
-            if self._stream_ok.is_set()
+            if self._stream_ok.is_set() or self._draining.is_set()
             else self._available.acquire(blocking=False)
         )
         # snapshot in-flight BEFORE draining statuses: a task finishing in
@@ -263,6 +339,8 @@ class PollLoop:
         # orphaned assignment and trigger a spurious requeue
         with self._inflight_mu:
             inflight = list(self._inflight.values())
+        # pops + the in-delivery count are one atomic step (see
+        # _drain_statuses): a failed RPC puts them back below
         statuses = self._drain_statuses()
         try:
             params = pb.PollWorkParams(
@@ -284,10 +362,16 @@ class PollLoop:
                 self._available.release()
             # the poll carried finished-task statuses; losing them would
             # wedge their jobs (the scheduler would wait forever) — requeue
-            # for the next poll, which retries the delivery
+            # for the next poll, which retries the delivery (BEFORE the
+            # finally's _delivering decrement, so drain never observes
+            # queue-empty + nothing-in-delivery while these are undelivered)
             for st in statuses:
                 self._finished.put(st)
             raise
+        finally:
+            if statuses:
+                with self._inflight_mu:
+                    self._delivering -= len(statuses)
         if result.HasField("task"):
             self._register_inflight(result.task)
             # slot ownership transfers to the task thread (released in
@@ -318,7 +402,7 @@ class PollLoop:
         from ballista_tpu.scheduler.rpc import backoff_delay
 
         failures = 0
-        while not self._stop.is_set():
+        while not self._stop.is_set() and not self._draining.is_set():
             params = pb.SubscribeWorkParams(slots=self.concurrent_tasks)
             params.metadata.CopyFrom(self.metadata)
             was_up = False
@@ -345,7 +429,7 @@ class PollLoop:
                 if was_up:
                     record_serving("push_stream_drop")
                 self._wake.set()  # fallback polling starts NOW
-            if self._stop.is_set():
+            if self._stop.is_set() or self._draining.is_set():
                 return
             failures += 1
             self._stop.wait(backoff_delay(failures - 1, 0.05, cap=2.0))
@@ -417,6 +501,27 @@ class PollLoop:
                 cfg = BallistaConfig(
                     {**cfg.to_dict(), **{kv.key: kv.value for kv in task.settings}}
                 )
+                # ... except the shuffle WRITE/READ home (ISSUE 15): like
+                # the data_roots allowlist, an executor whose OWN config
+                # pins a shuffle tier keeps it — per-job settings must not
+                # steer os.replace publishes (or confine storage reads) to
+                # a client-chosen host path. An unconfigured executor (the
+                # standalone/local default, tier=local + no dir) lets the
+                # job opt in, mirroring data_roots="" = unrestricted.
+                from ballista_tpu.config import (
+                    BALLISTA_SHUFFLE_DIR,
+                    BALLISTA_SHUFFLE_TIER,
+                )
+
+                if (
+                    self.config.shuffle_dir()
+                    or self.config.shuffle_tier() != "local"
+                ):
+                    cfg = BallistaConfig({
+                        **cfg.to_dict(),
+                        BALLISTA_SHUFFLE_TIER: self.config.shuffle_tier(),
+                        BALLISTA_SHUFFLE_DIR: self.config.shuffle_dir(),
+                    })
             ctx = TaskContext(
                 config=cfg,
                 work_dir=self.work_dir,
@@ -480,11 +585,19 @@ class PollLoop:
             if shared is not None:
                 ctx.shared_scan = shared
             stats = plan.execute_shuffle_write(pid.partition_id, ctx)
-            base = os.path.join(
-                self.work_dir, pid.job_id, str(pid.stage_id), str(pid.partition_id)
+            from ballista_tpu.distributed.stages import shuffle_output_base
+
+            # the path-home the writer actually used: the shared storage
+            # dir (tier=shared; storage_uri rides the completed status so
+            # the piece set survives this executor, ISSUE 15) or this
+            # executor's private work dir
+            base, storage_uri = shuffle_output_base(
+                ctx, pid.job_id, pid.stage_id, pid.partition_id
             )
             status.completed.executor_id = self.metadata.id
             status.completed.path = base
+            if storage_uri:
+                status.completed.storage_uri = storage_uri
             status.completed.stats.num_rows = stats.num_rows
             status.completed.stats.num_batches = stats.num_batches
             status.completed.stats.num_bytes = stats.num_bytes

@@ -61,12 +61,22 @@ class BallistaFlightService(flight.FlightServerBase):
         raise flight.FlightServerError(f"unsupported action {which!r}")
 
     def _resolve_work_path(self, raw: str) -> str:
-        """Confine ticket paths to this executor's work_dir. The ticket comes
-        from an unauthenticated peer; without this check FetchPartition would
-        serve any readable file on the host (ADVICE r1, high)."""
+        """Confine ticket paths to this executor's work_dir — or, with the
+        shared shuffle tier configured (ISSUE 15), to ITS OWN configured
+        storage root (never a per-job override: the ticket comes from an
+        unauthenticated peer, and self.config is the only trust anchor).
+        The storage fallback is what makes Flight a real backup transport
+        for storage-homed pieces: a reader without the mount can fetch them
+        through any live executor that has it. Without either check
+        FetchPartition would serve any readable file on the host
+        (ADVICE r1, high)."""
         from ballista_tpu.executor.confine import resolve_contained
 
         resolved = resolve_contained(raw, self.work_dir)
+        if resolved is None:
+            storage = self.config.shuffle_dir()
+            if storage:
+                resolved = resolve_contained(raw, storage)
         if resolved is None:
             raise flight.FlightServerError(
                 f"path outside work_dir refused: {raw!r}"
@@ -96,21 +106,33 @@ class BallistaFlightService(flight.FlightServerBase):
         check_scan_roots(plan, roots)
         import functools
 
-        cfg = BallistaConfig({**self.config.to_dict(), **{kv.key: kv.value for kv in settings}})
+        from ballista_tpu.config import BALLISTA_SHUFFLE_DIR, BALLISTA_SHUFFLE_TIER
+
+        # like the scan-root allowlist above, the shuffle WRITE home comes
+        # from the EXECUTOR's own config: an unauthenticated peer's
+        # settings must not steer execute_shuffle_write's os.replace
+        # publish to an arbitrary host path (pre-ISSUE-15 every write was
+        # confined to work_dir by construction)
+        cfg = BallistaConfig({
+            **self.config.to_dict(),
+            **{kv.key: kv.value for kv in settings},
+            BALLISTA_SHUFFLE_TIER: self.config.shuffle_tier(),
+            BALLISTA_SHUFFLE_DIR: self.config.shuffle_dir(),
+        })
         ctx = TaskContext(config=cfg, work_dir=self.work_dir, job_id=req.job_id,
                           shuffle_fetcher=functools.partial(
                               flight_shuffle_fetcher, config=cfg))
+        from ballista_tpu.distributed.stages import shuffle_output_base
+
         rows = []
         for p in req.partition_ids:
-            if isinstance(plan, ShuffleWriterExec):
-                stats = plan.execute_shuffle_write(p, ctx)
-                base = os.path.join(self.work_dir, req.job_id, str(req.stage_id), str(p))
-                rows.append((base, stats.num_rows, stats.num_batches, stats.num_bytes))
-            else:
-                w = ShuffleWriterExec(req.job_id, req.stage_id, plan, None)
-                stats = w.execute_shuffle_write(p, ctx)
-                base = os.path.join(self.work_dir, req.job_id, str(req.stage_id), str(p))
-                rows.append((base, stats.num_rows, stats.num_batches, stats.num_bytes))
+            if not isinstance(plan, ShuffleWriterExec):
+                plan = ShuffleWriterExec(req.job_id, req.stage_id, plan, None)
+            stats = plan.execute_shuffle_write(p, ctx)
+            # the base the writer actually used (work dir, or the shared
+            # storage dir when the merged config selects the shared tier)
+            base, _storage = shuffle_output_base(ctx, req.job_id, req.stage_id, p)
+            rows.append((base, stats.num_rows, stats.num_batches, stats.num_bytes))
         # 1-row-per-partition result batch (path, stats), ref flight_service.rs:135-160
         table = pa.table(
             {

@@ -278,6 +278,19 @@ def resolve_stage(exec_node, ctx) -> Tuple[object, str, str, float]:
     # persisted layout entry written before the flag existed
     if getattr(exec_node, "exact_floats", False):
         flags += ",ef=True"
+    # batch.size folds into the key the same append-only way (ISSUE 15
+    # satellite, PR 13 residue): the persisted layout's tile granularity
+    # follows batch size, and keying on it means a warm layout entry is
+    # ALWAYS at this dispatch's granularity — which is what makes
+    # layout-warm members shared-scan-eligible (the shared batch stream is
+    # then row-identical to the member's warm solo stream). The guarantee
+    # only holds for entries written under THIS keying scheme, so the
+    # layout-cache _FORMAT bump to 5 orphans every pre-keying store (a
+    # suffix-less v4 entry could have been written at any batch size).
+    from ballista_tpu.config import BALLISTA_BATCH_SIZE, DEFAULT_SETTINGS
+
+    if ctx.batch_size != int(DEFAULT_SETTINGS[BALLISTA_BATCH_SIZE]):
+        flags += f",bs={ctx.batch_size}"
     # decorrelated scalar subqueries equality-compare the aggregate result
     # against source values (q2: ps_supplycost = MIN(...)): float MIN/MAX
     # must be the bit-exact stored value. The fused stage delivers exactly

@@ -41,8 +41,13 @@ import numpy as np
 
 # bump to invalidate all persisted entries. v4: float-bits key planes join
 # the staged column set and the fused top-k epilogue forces a
-# one-chunk-per-group cover — entries written by v3 lack both.
-_FORMAT = 4
+# one-chunk-per-group cover — entries written by v3 lack both. v5
+# (ISSUE 15 satellite): batch.size folds into the stage/persist key
+# (append-only when non-default), and shared-scan eligibility RELIES on a
+# warm entry being at the dispatching batch granularity — a v4 store may
+# hold suffix-less entries written at ANY batch size, so it is orphaned
+# wholesale rather than trusted.
+_FORMAT = 5
 
 
 def cache_dir_for(base: str, stage_key: str, partition: int) -> str:

@@ -905,6 +905,69 @@ def shared_scan_stats(reset: bool = False) -> Dict[str, int]:
     return out
 
 
+# accumulated disaggregated-shuffle-tier events (ISSUE 15): where pieces
+# were published (storage_publish vs local_publish) and how readers
+# resolved them — storage_fetch = read straight from the shared dir,
+# peer_fetch = the Flight path (the local tier, and the fallback when a
+# storage-homed piece is unreadable, counted storage_fallback_peer beside
+# it), storage_publish_torn = a shuffle.store-chaos-torn publish (the task
+# failed and retried). bench.py's elastic scenario reports
+# storage-vs-peer fetch mix off this. Same in-process accumulator pattern
+# as recovery/tenancy/serving above.
+_shuffle_tier_lock = make_lock("ops.runtime._shuffle_tier_lock")
+# guarded-by: _shuffle_tier_lock
+_shuffle_tier: Dict[str, int] = {}  # event -> count
+
+
+def record_shuffle_tier(event: str, n: int = 1) -> None:
+    with _shuffle_tier_lock:
+        _shuffle_tier[event] = _shuffle_tier.get(event, 0) + int(n)
+
+
+def shuffle_tier_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated shuffle-tier counters."""
+    with _shuffle_tier_lock:
+        out = dict(_shuffle_tier)
+        if reset:
+            _shuffle_tier.clear()
+    return out
+
+
+# accumulated elastic-fleet events (ISSUE 15): autoscaler evaluations and
+# the scale actions they took (scale_up / scale_down by executor count,
+# scale_chaos_skipped = fleet.scale-torn decisions, drain_completed /
+# drain_timeout = graceful scale-in outcomes), plus the running gauges the
+# bench scenario samples (fleet_size = last observed size, backlog_ms =
+# last predicted backlog, peaks kept as fleet_size_peak / backlog_ms_peak).
+# Same in-process accumulator pattern as the counters above; gauges
+# overwrite instead of accumulate.
+_fleet_lock = make_lock("ops.runtime._fleet_lock")
+# guarded-by: _fleet_lock
+_fleet: Dict[str, float] = {}  # event -> count (or gauge value)
+
+
+def record_fleet(event: str, n: float = 1) -> None:
+    with _fleet_lock:
+        _fleet[event] = _fleet.get(event, 0) + n
+
+
+def record_fleet_gauge(gauge: str, value: float) -> None:
+    """Overwrite a fleet gauge, keeping its `_peak` sibling."""
+    with _fleet_lock:
+        _fleet[gauge] = value
+        peak = f"{gauge}_peak"
+        _fleet[peak] = max(_fleet.get(peak, value), value)
+
+
+def fleet_stats(reset: bool = False) -> Dict[str, float]:
+    """Snapshot of accumulated elastic-fleet counters and gauges."""
+    with _fleet_lock:
+        out = dict(_fleet)
+        if reset:
+            _fleet.clear()
+    return out
+
+
 # accumulated adaptive-routing decisions (ISSUE 10): every engine choice
 # the cost-model-aware ladder makes — device / host / split — lands here
 # with its predicted-vs-observed cost when a prediction existed, plus named

@@ -213,15 +213,13 @@ def _member_info(plan, partition: int, ctx) -> Optional[_Member]:
         # batch — shared-scan exists to amortize COLD scans across queries,
         # not to undo the residency tier
         return None
-    if ctx.config.tpu_layout_cache_dir() and stage.persist_key is not None:
-        # persisted-layout warm starts pin the member to the LAYOUT's batch
-        # granularity (the stage key excludes batch.size), and f32 partial
-        # sums are granularity-sensitive — a fresh-grain shared scan would
-        # not be bit-identical to the member's layout-cache solo run. The
-        # warm-start tier keeps its solo path; shared-scan serves the
-        # streaming/serving regime (layout cache off or non-persistable
-        # stages).
-        return None
+    # persisted-layout-warm members are shared-scan-ELIGIBLE since batch
+    # size folded into the stage/persist key (ISSUE 15 satellite, PR 13
+    # residue): a warm layout entry is always at THIS dispatch's batch
+    # granularity, so the shared batch stream is row-identical to the
+    # member's layout-cache solo stream and f32 partials fold identically.
+    # (The group key below already carries ctx.batch_size, so members of
+    # different granularities never group.)
     if stage.dicts.dicts:
         return None  # string-coded device columns: per-stage dictionaries
     schema = stage.scan_schema

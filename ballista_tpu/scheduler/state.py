@@ -54,6 +54,11 @@ EXECUTOR_LEASE_SECS = 60.0  # ref state/mod.rs:42
 # couple of executor poll intervals (0.25s) plus scheduling slack.
 ORPHANED_ASSIGNMENT_GRACE_SECS = 3.0
 
+# cold prior one never-observed pending task contributes to the predicted
+# autoscaling backlog (ISSUE 15): small enough that priors alone never
+# grow the fleet, nonzero so a deep cold queue still registers
+BACKLOG_COLD_TASK_SECONDS = 0.02
+
 
 def _record_recovery(event: str, n: int = 1) -> None:
     # lazy: scheduler state must stay importable before the ops runtime
@@ -324,6 +329,21 @@ class SchedulerState:
         self._spec_enabled = self.config.speculation()
         self._spec_multiplier = self.config.speculation_multiplier()
         self._spec_floor_s = self.config.speculation_min_runtime_s()
+        # re-speculation bound (ISSUE 15 satellite, PR 11 residue): a
+        # duplicate that itself straggles past the same threshold may be
+        # superseded by a fresh duplicate, up to this many launches per
+        # task. _spec_launches counts them; _spec_superseded remembers the
+        # ABANDONED duplicates' attempt numbers so their late reports are
+        # retired without touching the task (a superseded completion still
+        # wins — first completion wins, whoever crosses the line). Both
+        # in-memory, under the global KV lock like the ledger map; a
+        # restarted scheduler rebuilds the launch count from the ledger
+        # record (attempt arithmetic) and forgets the superseded set — the
+        # attempt-numbering floor in requeue_task keeps late reports from
+        # ever impersonating a fresh attempt regardless.
+        self._spec_max = self.config.speculation_max_attempts()
+        self._spec_launches: Dict[Tuple[str, int, int], int] = {}
+        self._spec_superseded: Dict[Tuple[str, int, int], set] = {}
         # running-task watch: (job, stage, part) -> (executor, attempt,
         # monotonic start). Maintained by save_task_status (the single task
         # write path), consumed by the straggler monitor and by the
@@ -442,6 +462,33 @@ class SchedulerState:
     def _spec_del(self, key: Tuple[str, int, int]) -> None:
         if self._speculative.pop(key, None) is not None:
             self.kv.delete(self._spec_key(key))
+        # the episode's launch budget resets with the ledger entry (a fresh
+        # straggler signal may speculate again, as before ISSUE 15) — but
+        # the SUPERSEDED set must outlive it: abandoned duplicates may
+        # still be running, and their late reports are retired against it
+        # until the task itself resolves (_spec_resolve).
+        self._spec_launches.pop(key, None)
+
+    def _spec_resolve(self, key: Tuple[str, int, int]) -> None:
+        """The TASK resolved (completion accepted, requeue, or job done):
+        close the whole speculation episode, superseded bookkeeping
+        included. Requeues number past every minted speculative attempt
+        (_spec_attempt_floor), so nothing retired here can impersonate a
+        fresh attempt later."""
+        self._spec_del(key)
+        self._spec_superseded.pop(key, None)
+
+    def _spec_attempt_floor(self, key: Tuple[str, int, int]) -> int:
+        """Highest speculative attempt ever minted for the task (the live
+        ledger entry and every superseded one): a requeue must number PAST
+        it, or a late report from an abandoned duplicate could impersonate
+        the fresh attempt and clobber its state."""
+        spec = self._speculative.get(key)
+        top = spec[1] if spec is not None else 0
+        sup = self._spec_superseded.get(key)
+        if sup:
+            top = max(top, max(sup))
+        return top
 
     def speculation_active(
         self, key: Tuple[str, int, int], executor_id: str, attempt: int
@@ -542,10 +589,12 @@ class SchedulerState:
             bump("restart_assignment_restored")
         for k, v in spec_ledger:
             # speculative duplicates (ISSUE 11): valid while the primary is
-            # still RUNNING at exactly attempt-1 — the pair's completions
-            # then resolve through the normal first-completion-wins path.
-            # Anything else (primary resolved, requeued, or the pair
-            # already settled) is a leftover record to sweep.
+            # still RUNNING at a LOWER attempt (exactly attempt-1 for a
+            # single speculation; further behind after re-speculation,
+            # ISSUE 15) — the pair's completions then resolve through the
+            # normal first-completion-wins path. Anything else (primary
+            # resolved, requeued, or the pair already settled) is a
+            # leftover record to sweep.
             tail = k.rsplit("/", 3)
             key = (tail[1], int(tail[2]), int(tail[3]))
             a = pb.Assignment()
@@ -554,11 +603,15 @@ class SchedulerState:
             if (
                 cur is None
                 or cur.WhichOneof("status") != "running"
-                or cur.attempt != a.attempt - 1
+                or cur.attempt >= a.attempt
             ):
                 self.kv.delete(k)
                 continue
             self._speculative[key] = (a.executor_id, a.attempt, now, False, True)
+            # rebuild the launch bound from attempt arithmetic (the
+            # superseded set died with the old process; the requeue
+            # numbering floor covers its late reports regardless)
+            self._spec_launches[key] = max(1, a.attempt - cur.attempt)
             _record_speculation("restored")
             bump("restart_speculation_restored")
         if stats:
@@ -798,7 +851,14 @@ class SchedulerState:
             log.info("result-cache entry %s... expired (ttl %.0fs)",
                      fingerprint[:16], self.config.result_cache_ttl_s())
             return None
-        for eid in {pl.executor_meta.id for pl in entry.partition_location}:
+        # storage-homed locations (ISSUE 15) outlive their producer: only
+        # locations whose pieces live in an executor work dir need the
+        # owner's lease alive for the entry to stay servable
+        for eid in {
+            pl.executor_meta.id
+            for pl in entry.partition_location
+            if not pl.storage_uri
+        }:
             if self.get_executor_metadata(eid) is None:
                 self._result_cache_delete(fingerprint)
                 _record_tenancy("cache_invalidated")
@@ -923,6 +983,42 @@ class SchedulerState:
                 status.attempt, current.attempt,
             )
             return False
+        sup = self._spec_superseded.get(key3)
+        superseded_completion = False
+        if (
+            sup
+            and status.attempt in sup
+            and (spec is None or status.attempt != spec[1])
+        ):
+            # a report from an ABANDONED (re-speculated-over) duplicate
+            # (ISSUE 15 satellite): its failure touches nothing — the
+            # primary (and possibly a live successor duplicate) still runs
+            # — while its completion is as good as anyone's (first
+            # completion wins, whoever crosses the line) and falls through
+            # to the normal accept below.
+            sup.discard(status.attempt)
+            if not sup:
+                self._spec_superseded.pop(key3, None)
+            if w in ("failed", "fetch_failed"):
+                _record_speculation("superseded_failed")
+                if w == "fetch_failed":
+                    # like a live duplicate's fetch failure: the named map
+                    # output is gone for EVERY future consumer — recompute
+                    # it now (the reporter itself needs no requeue)
+                    self._recompute_lost_map(
+                        pid.job_id, status.fetch_failed,
+                        self.retry_limit(pid.job_id),
+                        "superseded speculative attempt",
+                    )
+                log.info(
+                    "superseded speculative attempt %d of %s/%s/%s failed; "
+                    "nothing to do", status.attempt,
+                    pid.job_id, pid.stage_id, pid.partition_id,
+                )
+                return False
+            if w == "completed":
+                superseded_completion = True
+                _record_speculation("superseded_won")
         if spec is not None:
             spec_exec, spec_attempt, spec_t0, _v, _r = spec
             if status.attempt == spec_attempt and w in ("failed", "fetch_failed"):
@@ -960,6 +1056,12 @@ class SchedulerState:
                         "wasted_seconds",
                         now - (prim[2] if prim is not None else spec_t0),
                     )
+                elif superseded_completion:
+                    # an ABANDONED duplicate crossed the line first: still
+                    # a speculative WIN (the duplicate rescued the task) —
+                    # the live successor's effort is what got wasted
+                    _record_speculation("won")
+                    _record_speculation("wasted_seconds", now - spec_t0)
                 else:
                     _record_speculation("lost")
                     _record_speculation("wasted_seconds", now - spec_t0)
@@ -967,7 +1069,8 @@ class SchedulerState:
                 log.info(
                     "speculation resolved for %s/%s/%s: %s attempt %d won",
                     pid.job_id, pid.stage_id, pid.partition_id,
-                    "speculative" if status.attempt == spec_attempt
+                    "speculative"
+                    if status.attempt == spec_attempt or superseded_completion
                     else "primary", status.attempt,
                 )
         if w == "completed":
@@ -994,6 +1097,11 @@ class SchedulerState:
         if merged.WhichOneof("status") in ("completed", "failed", "fetch_failed"):
             # the assignment resolved; stop watching for orphaning
             self._ledger_del((pid.job_id, pid.stage_id, pid.partition_id))
+        if merged.WhichOneof("status") == "completed":
+            # an accepted completion ends the speculation episode for good:
+            # superseded bookkeeping included (their late reports are
+            # dropped by the completion-stands guard from here on)
+            self._spec_resolve(key3)
         return True
 
     def _ensure_task_index(self) -> _TaskIndex:
@@ -1092,7 +1200,9 @@ class SchedulerState:
         if (
             promote
             and spec is not None
-            and spec[1] == t.attempt + 1
+            # any LATER attempt qualifies: re-speculation (ISSUE 15) may
+            # have advanced the duplicate past attempt+1
+            and spec[1] > t.attempt
             and spec[0] != executor_id
             # same budget bound as a normal requeue: a task already AT its
             # final allowed attempt must fail the job, not ride promotion
@@ -1142,21 +1252,25 @@ class SchedulerState:
             # exhausted: the job fails — retire any in-flight duplicate's
             # record with it (its late report is dropped by the guards)
             if spec is not None:
-                self._spec_del(key3)
                 _record_speculation("failed")
+            self._spec_resolve(key3)
             return False
         # any in-flight assignment of the superseded attempt is now stale;
         # clearing it here keeps the durable ledger from carrying entries a
         # restarted scheduler would have to re-validate and discard — a
         # stale speculation record included (the requeued attempt would
-        # collide with the duplicate's attempt number)
+        # collide with the duplicate's attempt number). The fresh attempt
+        # numbers PAST every speculative attempt ever minted for the task
+        # (the abandoned ones included), so no late duplicate report can
+        # impersonate it.
+        floor = self._spec_attempt_floor(key3)
         self._ledger_del((pid0.job_id, pid0.stage_id, pid0.partition_id))
         if spec is not None:
-            self._spec_del(key3)
             _record_speculation("failed")
+        self._spec_resolve(key3)
         pending = pb.TaskStatus()
         pending.partition_id.CopyFrom(t.partition_id)
-        pending.attempt = t.attempt + 1
+        pending.attempt = max(t.attempt, floor) + 1
         pending.history.MergeFrom(t.history)
         h = pending.history.add()
         h.attempt = t.attempt
@@ -1244,6 +1358,16 @@ class SchedulerState:
                 owner = t.completed.executor_id
             if owner is None or owner in alive:
                 continue
+            if w == "completed" and t.completed.storage_uri:
+                # disaggregated tier (ISSUE 15): the output's home is a
+                # PATH in shared storage, not the dead executor — the
+                # pieces are still readable, so executor death after map
+                # completion is a NON-EVENT: no requeue, no lineage
+                # invalidation, no task retries. (A piece that really did
+                # vanish from storage surfaces later as a reader's
+                # fetch_failed and recovers through lineage as usual.)
+                _record_recovery("storage_home_retained")
+                continue
             error = (
                 f"executor {owner} lease expired while the task ran"
                 if w == "running"
@@ -1301,7 +1425,10 @@ class SchedulerState:
                 self._running_since.pop(key, None)
         for key in list(self._speculative):
             if job_finished(key[0]):
-                self._spec_del(key)
+                self._spec_resolve(key)
+        for key in list(self._spec_superseded):
+            if job_finished(key[0]):
+                self._spec_superseded.pop(key, None)
         for key in list(self._batch_members):
             if job_finished(key[0]):
                 self._note_batch_member_done(key, clean=False)
@@ -1454,6 +1581,11 @@ class SchedulerState:
                         t.completed.path,
                         stage_id=u.stage_id,
                         map_partition=t.partition_id.partition_id,
+                        # shared tier (ISSUE 15): a storage-homed piece set
+                        # binds even when its producer's lease lapsed —
+                        # readers resolve it from the mount (host/port stay
+                        # the fallback transport while the producer lives)
+                        storage_uri=t.completed.storage_uri,
                     )
                 )
             locations[u.stage_id] = locs
@@ -1504,6 +1636,42 @@ class SchedulerState:
         if local is not None and local[1] >= costmodel.MIN_OBSERVATIONS:
             return local[0] / local[1]
         return costmodel.predict(op, 1.0, engine="task")
+
+    def has_running_tasks(self) -> bool:
+        """True while any task is RUNNING in a live job — the autoscaler's
+        idle check (a drain must never start under in-flight work it can
+        see coming). Caller holds the global KV lock, like every index
+        consumer."""
+        idx = self._ensure_task_index()
+        return any(parts for parts in idx.running.values())
+
+    def predicted_backlog_seconds(self) -> float:
+        """Cost-model-predicted seconds of PENDING work across live jobs —
+        the autoscaling signal (ISSUE 15): the same task.run rates the
+        straggler monitor predicts from, summed over every pending task of
+        every non-terminal job. Stages the model has never observed count a
+        small cold prior each (a deep cold queue still registers as
+        backlog; the prior is deliberately below any task worth scaling
+        for, so an idle-ish cluster never grows on priors alone). Caller
+        holds the global KV lock, like every index consumer."""
+        idx = self._ensure_task_index()
+        job_live: Dict[str, bool] = {}
+        total = 0.0
+        for (job_id, stage_id), parts in list(idx.pending.items()):
+            if not parts:
+                continue
+            if job_id not in job_live:
+                js = self.get_job_metadata(job_id)
+                job_live[job_id] = js is not None and js.WhichOneof(
+                    "status"
+                ) == "running"
+            if not job_live[job_id]:
+                continue
+            pred = self._predict_task_run(job_id, stage_id)
+            total += (
+                pred if pred is not None else BACKLOG_COLD_TASK_SECONDS
+            ) * len(parts)
+        return total
 
     def _straggler_candidates(
         self, now: float
@@ -1879,8 +2047,22 @@ class SchedulerState:
             if entry is None:
                 continue
             owner, attempt, t0 = entry
-            if key3 in self._speculative or owner == executor_id:
+            if owner == executor_id:
                 continue
+            spec = self._speculative.get(key3)
+            if spec is not None:
+                # re-speculation (ISSUE 15 satellite, PR 11 residue): the
+                # live duplicate may ITSELF straggle past the same
+                # cost-model threshold (floor included — its own clock,
+                # not the primary's). Bounded by speculation.max_attempts
+                # launches per episode; the straggler is superseded in the
+                # ledger, its late reports retired via _spec_superseded.
+                if spec[0] == executor_id:
+                    continue
+                if self._spec_launches.get(key3, 1) >= self._spec_max:
+                    continue
+                if now - spec[2] < self._spec_floor_s:
+                    continue
             if key3 in self._batch_members:
                 # a shared-scan batch member (ISSUE 13) is co-scheduled
                 # with its siblings: its wall time is the BATCH's, not a
@@ -1889,7 +2071,9 @@ class SchedulerState:
                 # finishing (real batch loss is covered by the normal
                 # lease/orphan machinery)
                 continue
-            elapsed = now - t0
+            # the straggler under judgment: the primary on a first
+            # speculation, the LIVE DUPLICATE on a re-speculation
+            elapsed = now - (t0 if spec is None else spec[2])
             pred = self._predict_task_run(key3[0], key3[1])
             if pred is None or elapsed <= self._spec_multiplier * max(pred, 1e-6):
                 continue
@@ -1933,15 +2117,27 @@ class SchedulerState:
                 continue
             dup = pb.TaskStatus()
             dup.partition_id.CopyFrom(cur.partition_id)
-            dup.attempt = cur.attempt + 1
             dup.speculative = True
+            if spec is not None:
+                # supersede the straggling duplicate: it keeps running
+                # (first completion wins, whoever crosses the line), but
+                # the ledger now tracks its successor and its own late
+                # reports retire against the superseded set
+                dup.attempt = spec[1] + 1
+                self._spec_superseded.setdefault(key3, set()).add(spec[1])
+                self._spec_launches[key3] = self._spec_launches.get(key3, 1) + 1
+                _record_speculation("relaunched")
+            else:
+                dup.attempt = cur.attempt + 1
+                self._spec_launches[key3] = 1
             self._spec_put(key3, executor_id, dup.attempt)
             self.note_tenant_assigned(self.job_tenant(job_id)[0])
             _record_speculation("launched")
             log.warning(
-                "speculating %s/%s/%s on %s (attempt %d): elapsed %.3fs > "
+                "speculating %s/%s/%s on %s (attempt %d%s): elapsed %.3fs > "
                 "%.1fx predicted %.3fs (primary %s)",
                 job_id, stage_id, partition, executor_id, dup.attempt,
+                " re-speculated" if spec is not None else "",
                 elapsed, self._spec_multiplier, pred, owner,
             )
             return dup, bound
@@ -2306,6 +2502,7 @@ class SchedulerState:
                     pl.executor_meta.CopyFrom(meta)
                 pl.path = t.completed.path
                 pl.partition_stats.CopyFrom(t.completed.stats)
+                pl.storage_uri = t.completed.storage_uri
         else:
             status.running.SetInParent()
             # per-partition completion notifications (ISSUE 8): publish the
@@ -2329,6 +2526,7 @@ class SchedulerState:
                     pl.executor_meta.CopyFrom(meta)
                 pl.path = t.completed.path
                 pl.partition_stats.CopyFrom(t.completed.stats)
+                pl.storage_uri = t.completed.storage_uri
         self.save_job_metadata(job_id, status)
         if status.WhichOneof("status") == "completed":
             self._note_job_slo(job_id)

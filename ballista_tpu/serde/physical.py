@@ -220,6 +220,9 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             # exactly what the scheduler must recompute
             pl.partition_id.stage_id = loc.stage_id
             pl.partition_id.partition_id = loc.map_partition
+            # disaggregated tier (ISSUE 15): the path-home rides the wire so
+            # the executing reader resolves storage-first
+            pl.storage_uri = loc.storage_uri
         n.shuffle_reader.schema_ipc = schema_to_ipc(plan.schema())
         n.shuffle_reader.num_partitions = plan.num_partitions
         n.shuffle_reader.identity = plan.identity
@@ -406,6 +409,7 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
                 pl.path,
                 stage_id=pl.partition_id.stage_id,
                 map_partition=pl.partition_id.partition_id,
+                storage_uri=pl.storage_uri,
             )
             for pl in n.shuffle_reader.partition_locations
         ]

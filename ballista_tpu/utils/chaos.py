@@ -72,6 +72,24 @@ SITES = (
                              # construction; keyed on a generation-rotated
                              # per-process sequence so a restarted scheduler
                              # draws fresh verdicts.
+    "shuffle.store",         # shared-shuffle-storage tier (ISSUE 15,
+                             # distributed/stages.py). Two seams, both keyed
+                             # on plan coordinates + attempt: a WRITE verdict
+                             # tears the atomic publish of a map task's piece
+                             # set (the task fails and retries — a retried
+                             # attempt draws fresh), and a READ verdict makes
+                             # a published piece unreadable from storage for
+                             # that consuming attempt — the reader degrades
+                             # down the fallback ladder (Flight peer fetch,
+                             # then fetch_failed -> lineage recompute),
+                             # bit-identical by construction.
+    "fleet.scale",           # autoscaler decision (ISSUE 15,
+                             # executor/runtime.py): a torn verdict skips
+                             # that evaluation's scale action entirely — the
+                             # fleet stays at its current size and the next
+                             # evaluation draws fresh (sequence-keyed). Never
+                             # tears a drain mid-way: the decision aborts
+                             # BEFORE any executor is touched.
     "task.slow",             # deterministic straggler injection (ISSUE 11,
                              # execution_loop.py): a task whose (stage,
                              # partition, attempt) coordinate draws a slow

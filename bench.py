@@ -1219,10 +1219,11 @@ def _sharedscan_scenario() -> dict | None:
             # the host decoded-table cache would likewise hide the scan
             # this scenario is about (real serving working sets exceed it)
             "ballista.scan.cache": "false",
-            # and the persisted layout tier pins solo runs to ITS batch
-            # granularity (stage keys exclude batch.size), which makes
-            # layout-warm members shared-scan-ineligible by design — the
-            # scenario runs the streaming regime that tier doesn't serve
+            # the persisted layout tier is off for the same reason as the
+            # scan cache: the scenario measures the streaming regime.
+            # (Layout-warm members are shared-scan-ELIGIBLE since ISSUE 15
+            # folded batch.size into the persist key — eligibility no
+            # longer depends on this knob.)
             "ballista.tpu.layout_cache_dir": "",
         }
 
@@ -1369,6 +1370,142 @@ def _sharedscan_scenario() -> dict | None:
     return result
 
 
+def _elastic_scenario() -> dict | None:
+    """Elastic-fleet scenario (ISSUE 15): a burst of concurrent jobs on the
+    SHARED shuffle tier against an autoscaled cluster (min=1, max=3) — the
+    admission queue's cost-model-predicted backlog grows the fleet, every
+    job completes bit-identical to a fixed single-executor reference with
+    ZERO task retries, and the idle fleet drains gracefully back to min.
+    Reports fleet-size/backlog gauges (peaks included), the scale/drain
+    counters, and the storage-vs-peer shuffle fetch mix.
+
+    Knobs: BENCH_ELASTIC_JOBS (default 6), BENCH_ELASTIC_ROWS (default
+    60000), BENCH_ELASTIC_MAX (default 3)."""
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import (
+        fleet_stats,
+        recovery_stats,
+        shuffle_tier_stats,
+    )
+    from ballista_tpu.proto import ballista_pb2 as pb
+
+    n_jobs = int(os.environ.get("BENCH_ELASTIC_JOBS", "6"))
+    n_rows = int(os.environ.get("BENCH_ELASTIC_ROWS", "60000"))
+    fleet_max = int(os.environ.get("BENCH_ELASTIC_MAX", "3"))
+    rng = np.random.default_rng(15)
+    table = pa.table({
+        "g": pa.array(rng.integers(0, 11, n_rows), type=pa.int64()),
+        "v": pa.array(np.round(rng.uniform(-100, 100, n_rows), 2)),
+        "q": pa.array(rng.integers(1, 50, n_rows), type=pa.int64()),
+    })
+    sql = ("select g, sum(v) as s, min(q) as mn, max(q) as mx, count(*) as n "
+           "from t group by g order by g")
+
+    with tempfile.TemporaryDirectory(prefix="ballista-elastic-") as shared:
+        client_settings = {
+            "ballista.shuffle.partitions": "8",
+            "ballista.cache.results": "false",
+            "ballista.shuffle.tier": "shared",
+            "ballista.shuffle.dir": shared,
+        }
+        # fixed single-executor reference (also the bit-identity oracle)
+        cluster = StandaloneCluster(n_executors=1)
+        try:
+            ctx = BallistaContext(
+                *cluster.scheduler_addr, settings=client_settings
+            )
+            ctx.register_record_batches("t", table, n_partitions=8)
+            ref = ctx.sql(sql).collect()
+            ctx.close()
+        finally:
+            cluster.shutdown()
+
+        fleet_stats(reset=True)
+        recovery_stats(reset=True)
+        shuffle_tier_stats(reset=True)
+        cluster = StandaloneCluster(
+            n_executors=1,
+            config=BallistaConfig({
+                "ballista.fleet.min": "1",
+                "ballista.fleet.max": str(fleet_max),
+                "ballista.fleet.interval_s": "0.1",
+                "ballista.fleet.target_backlog_s": "0.05",
+            }),
+        )
+        try:
+            ctx = BallistaContext(
+                *cluster.scheduler_addr, settings=client_settings
+            )
+            ctx.register_record_batches("t", table, n_partitions=8)
+            t0 = time.perf_counter()
+            jobs = [ctx.submit(ctx.sql(sql).logical_plan())
+                    for _ in range(n_jobs)]
+            peak = cluster.fleet_size()
+            deadline = time.time() + 120
+            statuses = []
+            while time.time() < deadline:
+                peak = max(peak, cluster.fleet_size())
+                statuses = [
+                    ctx._client.get_job_status(
+                        pb.GetJobStatusParams(job_id=j)
+                    ).status
+                    for j in jobs
+                ]
+                if all(
+                    s.WhichOneof("status") in ("completed", "failed")
+                    for s in statuses
+                ):
+                    break
+                time.sleep(0.05)
+            completed = sum(
+                1 for s in statuses if s.WhichOneof("status") == "completed"
+            )
+            bit_identical = completed == n_jobs
+            for j in jobs:
+                got = ctx._collect_results(j, ref.schema)
+                bit_identical = bit_identical and got.equals(ref)
+            wall = time.perf_counter() - t0
+            # idle drain back to min
+            deadline = time.time() + 60
+            while time.time() < deadline and cluster.fleet_size() > 1:
+                time.sleep(0.1)
+            fleet_final = cluster.fleet_size()
+            ctx.close()
+        finally:
+            cluster.shutdown()
+
+    fl = fleet_stats(reset=True)
+    tier = shuffle_tier_stats(reset=True)
+    rec = recovery_stats(reset=True)
+    result = {
+        "jobs": n_jobs,
+        "fleet_min": 1,
+        "fleet_max": fleet_max,
+        "fleet_peak": int(peak),
+        "fleet_final": int(fleet_final),
+        "backlog_ms_peak": round(fl.get("backlog_ms_peak", 0.0), 1),
+        "wall_s": round(wall, 2),
+        "bit_identical": bit_identical,
+        "fleet": {k: v for k, v in fl.items()},
+        "shuffle_tier": tier,
+        "task_retries": int(rec.get("task_retry", 0)),
+    }
+    print(f"[elastic] peak={result['fleet_peak']} "
+          f"final={result['fleet_final']} "
+          f"backlog_ms_peak={result['backlog_ms_peak']} "
+          f"storage_fetch={tier.get('storage_fetch', 0)} "
+          f"peer_fetch={tier.get('peer_fetch', 0)} "
+          f"bit_identical={bit_identical}", file=sys.stderr)
+    return result
+
+
 def _routing_scenario() -> dict | None:
     """Adaptive-execution smoke (ISSUE 10): an in-process skewed join whose
     build-key multiplicity sits past the static admission ladder, run cold,
@@ -1460,6 +1597,10 @@ def main() -> None:
         # shared-scan scenario only: runs without a reachable device
         print(json.dumps({"shared_scan": _sharedscan_scenario()}))
         return
+    if os.environ.get("BENCH_ELASTIC_ONLY"):
+        # elastic-fleet scenario only: runs without a reachable device
+        print(json.dumps({"elastic": _elastic_scenario()}))
+        return
     _probe_device()
     ensure_data(SF)
     import pyarrow.parquet as pq
@@ -1549,6 +1690,14 @@ def main() -> None:
             speculation = None
         if speculation is not None:
             result["speculation"] = speculation
+    if time.monotonic() - _T_START <= MAX_SECONDS:
+        try:
+            elastic = _elastic_scenario()
+        except Exception as e:
+            print(f"[elastic] failed: {e}", file=sys.stderr)
+            elastic = None
+        if elastic is not None:
+            result["elastic"] = elastic
     try:
         import jax
 

@@ -46,7 +46,7 @@ _DEFAULT_SITES = frozenset(
         "flight.fetch", "rpc.call", "task.execute", "kv.put",
         "executor.death", "scheduler.plan_write", "scheduler.crash",
         "cache.put", "scheduler.admit", "scheduler.push", "aot.load",
-        "scheduler.batch", "task.slow",
+        "scheduler.batch", "task.slow", "shuffle.store", "fleet.scale",
     }
 )
 

@@ -12,8 +12,12 @@ SF=${SF:-0.01}
 # strict static-analysis gate FIRST: the device-path invariants (readback
 # accounting, tracer hygiene, dtype narrowing, lock discipline, decline
 # ladder) are machine-checked before anything executes — a violation fails
-# the tier in seconds instead of surfacing as a wrong bench number later
-python -m dev.analysis ballista_tpu/
+# the tier in seconds instead of surfacing as a wrong bench number later.
+# --jobs 8 (ISSUE 15 satellite, PR 14 residue): per-file analysis fans out
+# over a process pool — 5.2s -> 1.6s cold on a 24-core box — with output
+# and cache semantics identical to serial (pinned by
+# tests/test_lockorder.py::test_jobs_parallel_matches_serial_and_caches).
+python -m dev.analysis --jobs 8 ballista_tpu/
 
 [ -d "$DATA/lineitem" ] || python -m benchmarks.tpch.runner datagen --sf "$SF" --out "$DATA" --parts 2
 
@@ -198,6 +202,62 @@ print("shared-scan smoke OK:",
       {"qps": {t: r["qps"] for t, r in by.items()},
        "counters": ss})
 PY
+
+# strict gate on the disaggregated shuffle tier + elastic fleet (ISSUE 15):
+# shared-storage piece publish (atomic tmp-then-replace, shuffle.store
+# write chaos tearing nothing visible), the storage-first reader ladder
+# (storage -> Flight peer -> fetch_failed/lineage), executor death after
+# map/job completion as a NON-EVENT (zero retries, zero lineage recomputes,
+# vs nonzero on the local tier in the same harness), graceful
+# scale-in-during-a-running-job bit-identical with zero retries, the
+# backlog-driven autoscaler (grow under load, drain when idle), and the
+# shared-tier fuzz slice (random 2-stage plans under shuffle.store +
+# executor.death chaos, bit-identical to the local-tier fault-free
+# baseline).
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_elastic_shuffle.py \
+    "tests/test_fuzz_device.py::test_fuzz_shared_tier_chaos"
+
+# elastic-fleet bench smoke (ISSUE 15): a burst of concurrent jobs on the
+# shared tier against an autoscaled cluster — the fleet must GROW under
+# the injected (cost-model-predicted) backlog, drain back to min when
+# idle, fetch shuffle pieces from storage, and complete every job
+# bit-identical with zero task retries.
+JAX_PLATFORMS=cpu BENCH_ELASTIC_ONLY=1 python bench.py \
+    > /tmp/_ballista_elastic_smoke.json
+python - /tmp/_ballista_elastic_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["elastic"]
+assert rec is not None, "elastic scenario returned no record"
+assert rec["bit_identical"], "elastic fleet changed results"
+assert rec["fleet_peak"] > rec["fleet_min"], f"fleet never grew: {rec}"
+assert rec["fleet_final"] == rec["fleet_min"], f"fleet never drained: {rec}"
+assert rec["backlog_ms_peak"] > 0, rec
+assert rec["task_retries"] == 0, rec
+fl, tier = rec["fleet"], rec["shuffle_tier"]
+assert fl.get("scale_up", 0) >= 1 and fl.get("scale_down", 0) >= 1, fl
+assert fl.get("drain_completed", 0) >= fl.get("scale_down", 0), fl
+assert tier.get("storage_publish", 0) > 0, tier
+assert tier.get("storage_fetch", 0) > 0, tier
+print("elastic smoke OK:",
+      {"fleet_peak": rec["fleet_peak"], "fleet_final": rec["fleet_final"],
+       "backlog_ms_peak": rec["backlog_ms_peak"],
+       "storage_fetch": tier.get("storage_fetch"),
+       "peer_fetch": tier.get("peer_fetch", 0)})
+PY
+
+# scale-in chaos e2e under the dynamic lock witness (ISSUE 15 satellite):
+# the graceful drain/retire path — autoscaler decision machinery included,
+# fleet.scale chaos armed — runs with every project lock asserting the
+# declared order at acquisition time. Hard asserts: the test's own
+# bit-identity + zero-retry contract, ZERO order violations, and ZERO
+# runtime edges the static analyzer missed.
+rm -f /tmp/_ballista_witness_elastic.json
+JAX_PLATFORMS=cpu BALLISTA_LOCK_WITNESS=1 \
+    BALLISTA_LOCK_WITNESS_OUT=/tmp/_ballista_witness_elastic.json \
+    python -m pytest -q -p no:cacheprovider \
+    "tests/test_elastic_shuffle.py::test_scale_in_during_running_job_bit_identical_zero_retries"
+python -m dev.analysis --check-witness /tmp/_ballista_witness_elastic.json ballista_tpu
 
 # strict gate on the concurrency analyzer (ISSUE 14): lock-order graph
 # construction, cycle detection, manifest round-trip + enforcement

@@ -302,6 +302,27 @@ def edit_issue13_shared_scan(fdp) -> None:
     )
 
 
+def edit_issue15_disaggregated_shuffle(fdp) -> None:
+    """ISSUE 15: disaggregated shuffle tier.
+
+    Adds (wire-compatible field additions):
+    - CompletedTask.storage_uri: non-empty when the task's shuffle pieces
+      were published to SHARED storage (ballista.shuffle.tier = shared)
+      rather than the executor's private work dir. The piece set's home is
+      then a PATH, not a process: the scheduler's lost-task sweep keeps the
+      completed output when the executor dies, and readers resolve the
+      pieces from storage first with the Flight peer fetch as fallback.
+    - PartitionLocation.storage_uri: the same home, propagated onto every
+      location record — bound shuffle-reader plans (serde), the partial/
+      completed result locations clients fetch from, and the result-cache
+      entries whose liveness no longer depends on the producing executor's
+      lease when the data is storage-homed.
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+    add_field(msgs["CompletedTask"], "storage_uri", 4, STR)
+    add_field(msgs["PartitionLocation"], "storage_uri", 5, STR)
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
@@ -311,6 +332,7 @@ APPLIED = [
     edit_issue8_latency_tier,
     edit_issue11_speculation,
     edit_issue13_shared_scan,
+    edit_issue15_disaggregated_shuffle,
 ]
 
 

@@ -760,10 +760,12 @@ def test_restart_completed_job_declines_non_terminal_and_unknown():
 
 
 def test_work_dir_gc(tmp_path):
+    from ballista_tpu.config import BallistaConfig
     from ballista_tpu.executor.execution_loop import PollLoop
 
     loop = PollLoop.__new__(PollLoop)  # no scheduler needed
     loop.work_dir = str(tmp_path)
+    loop.config = BallistaConfig()  # the sweep reads the storage root too
     loop.shuffle_ttl_seconds = 0.1
     old = tmp_path / "old_job"
     old.mkdir()

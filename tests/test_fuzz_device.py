@@ -700,3 +700,89 @@ def test_fuzz_routing(tmp_path, seed):
     finally:
         costmodel.reset(clear_dir=True)
         _fresh()
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_shared_tier_chaos(seed, tmp_path):
+    """Shared-tier fuzz slice (ISSUE 15 satellite): random 2-stage plans on
+    the SHARED shuffle tier under seeded shuffle.store chaos (torn storage
+    publishes retry; torn storage reads degrade down the peer/lineage
+    ladder) PLUS a deterministic mid-run executor death — results must be
+    bit-identical to the LOCAL-tier fault-free baseline. Own rng streams
+    (24000+ data, 25000+ queries), so every baseline stream above stays
+    byte-identical."""
+    import time as _time
+
+    import ballista_tpu.scheduler.state as state_mod
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.ops.runtime import recovery_stats, shuffle_tier_stats
+    from ballista_tpu.utils.chaos import ChaosInjector
+
+    rng = np.random.default_rng(24000 + seed)
+    qrng = np.random.default_rng(25000 + seed)
+    _fresh()
+    n = int(rng.integers(2_000, 8_000))
+    table = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+            "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+            "s": pa.array([f"t{x}" for x in rng.integers(0, 5, n)]),
+        }
+    )
+    queries = _distributed_fuzz_queries(qrng)
+
+    clean = _run_distributed(
+        table, queries, {"ballista.shuffle.partitions": "4"}
+    )
+
+    # deterministic executor death: local-0 dies within its first polls,
+    # local-1 survives the whole run (pure hashing, stable forever)
+    death_seed = None
+    for cand in range(2000):
+        inj = ChaosInjector(cand, 0.005, sites={"executor.death"})
+
+        def death_poll(eid, horizon):
+            for k in range(1, horizon):
+                if inj.should_inject("executor.death", f"{eid}/poll{k}"):
+                    return k
+            return None
+
+        d0 = death_poll("local-0", 17)
+        if d0 is not None and 4 <= d0 and death_poll("local-1", 400) is None:
+            death_seed = cand
+            break
+    assert death_seed is not None, "no death seed in scan range"
+
+    shared = str(tmp_path / f"store{seed}")
+    chaos_client = {
+        "ballista.shuffle.partitions": "4",
+        "ballista.shuffle.tier": "shared",
+        "ballista.shuffle.dir": shared,
+        "ballista.chaos.rate": "0.05",
+        "ballista.chaos.seed": str(170 + seed),
+        "ballista.chaos.sites": "shuffle.store",
+        "ballista.shuffle.max_task_retries": "5",
+    }
+    chaos_cluster = BallistaConfig({
+        "ballista.chaos.rate": "0.005",
+        "ballista.chaos.seed": str(death_seed),
+        "ballista.chaos.sites": "executor.death",
+        "ballista.shuffle.max_task_retries": "5",
+    })
+    old_lease = state_mod.EXECUTOR_LEASE_SECS
+    state_mod.EXECUTOR_LEASE_SECS = 1.0
+    recovery_stats(reset=True)
+    shuffle_tier_stats(reset=True)
+    try:
+        chaotic = _run_distributed(table, queries, chaos_client, chaos_cluster)
+    finally:
+        state_mod.EXECUTOR_LEASE_SECS = old_lease
+    stats = recovery_stats(reset=True)
+    tier = shuffle_tier_stats(reset=True)
+    for sql, c, t in zip(queries, clean, chaotic):
+        assert t.equals(c), (sql, t.to_pydict(), c.to_pydict())
+    assert stats.get("chaos_injected", 0) > 0, stats
+    assert stats.get("chaos_executor_death", 0) >= 1, stats
+    assert tier.get("storage_publish", 0) > 0, tier
+    assert tier.get("storage_fetch", 0) > 0, tier

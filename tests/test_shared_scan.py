@@ -529,3 +529,37 @@ def test_form_shared_batch_fair_share_sibling_order():
     # and the re-ranking interleaves rather than draining one tenant:
     # light (0 in flight) first, then heavy's fair share
     assert members[0] == "l1", members
+
+
+# -- layout-warm members are shared-scan-eligible (ISSUE 15 satellite) -------
+
+def test_layout_warm_member_batches_bit_identical(table_path, tmp_path):
+    """PR 13 residue: batch.size now folds into the stage/persist key, so a
+    persisted-layout-WARM member is shared-scan-eligible — the warm layout
+    is guaranteed to be at this dispatch's batch granularity, making the
+    shared batch stream row-identical to the member's layout-cache solo
+    run. Pre-fix, any member with a persist key and a configured layout dir
+    silently degraded to solo. Warm the persisted layouts with a sequential
+    pass, then batch concurrently on the SAME layout dir: batches must
+    form and every member must be bit-identical to its warm solo run."""
+    layout_dir = str(tmp_path / "layouts")
+    warm = _client_settings(
+        **{"ballista.tpu.layout_cache_dir": layout_dir}
+    )
+    # sequential warm pass: persists each member stage's layout
+    solo = _run_sequential(table_path, QUERIES, client_settings=warm)
+    import os
+
+    assert os.path.isdir(layout_dir) and os.listdir(layout_dir), (
+        "warm pass persisted no layout entries — the regression test "
+        "would not exercise the layout-warm path"
+    )
+    shared_scan_stats(reset=True)
+    batched = _run_concurrent(table_path, QUERIES, client_settings=warm)
+    stats = shared_scan_stats(reset=True)
+    for q, got, want in zip(QUERIES, batched, solo):
+        assert got == want, (q, got, want)
+    # the whole point: layout-warm members now group and share the scan
+    assert stats.get("batches_formed", 0) >= 1, stats
+    assert stats.get("shared_groups", 0) >= 1, stats
+    assert stats.get("uploads_saved", 0) >= 1, stats

@@ -344,7 +344,10 @@ def test_lineage_invalidation_retires_instead_of_promoting():
     assert stats.get("failed") == 1  # the duplicate retired with the reset
     assert s.kv.get(SPEC_KEY) is None
     cur = s.get_task_status("j", 1, 0)
-    assert cur.WhichOneof("status") is None and cur.attempt == 1  # pending
+    # pending, numbered PAST the retired duplicate's attempt 1 (ISSUE 15:
+    # the retired duplicate may still be running — a same-number requeue
+    # would let its late report impersonate the fresh attempt)
+    assert cur.WhichOneof("status") is None and cur.attempt == 2
 
 
 def test_push_status_suppresses_unchanged_rewrites():
@@ -822,3 +825,293 @@ def test_straggler_heap_early_exits_on_young_tasks():
     assert s._straggler_candidates(time.monotonic()) == []
     # the heap survives the walk intact for the next slot
     assert len(s._running_heap) == 8
+
+
+# -- re-speculation (ISSUE 15 satellite, PR 11 residue) ----------------------
+
+
+def _age_live_duplicate(s, seconds=5.0, key=("j", 1, 0)):
+    ex, at, t0, v, r = s._speculative[key]
+    s._speculative[key] = (ex, at, t0 - seconds, v, r)
+
+
+def test_respeculation_supersedes_straggling_duplicate():
+    """A duplicate that ITSELF straggles past the same cost-model threshold
+    is superseded by a fresh duplicate on a third executor: the ledger now
+    tracks attempt 2, the abandoned attempt 1 lands in the superseded set,
+    and the launch count enforces ballista.speculation.max_attempts."""
+    speculation_stats(reset=True)
+    s = _straggling_state()
+    s.save_executor_metadata(_meta("e3"))
+    s.save_executor_metadata(_meta("e4"))
+    assert s.maybe_speculate("e2") is not None
+    # age the LIVE duplicate's launch clock so it reads as a straggler
+    # against the warm ~1ms rate (the judgment is on its own clock)
+    _age_live_duplicate(s)
+    got = s.maybe_speculate("e3")
+    assert got is not None
+    dup, _plan = got
+    assert dup.attempt == 2 and dup.speculative
+    raw = s.kv.get(SPEC_KEY)
+    a = pb.Assignment()
+    a.ParseFromString(raw)
+    assert a.executor_id == "e3" and a.attempt == 2
+    assert s._spec_superseded[("j", 1, 0)] == {1}
+    assert s._spec_launches[("j", 1, 0)] == 2
+    stats = speculation_stats()
+    assert stats.get("launched") == 2 and stats.get("relaunched") == 1
+    # bounded: max_attempts=2 (default) — a third launch never happens,
+    # however long the second duplicate straggles
+    _age_live_duplicate(s)
+    assert s.maybe_speculate("e4") is None
+
+
+def test_respeculation_bounded_by_max_attempts_one():
+    """ballista.speculation.max_attempts=1 restores launch-once exactly."""
+    s = _straggling_state(
+        config=_spec_config(**{"ballista.speculation.max_attempts": "1"})
+    )
+    s.save_executor_metadata(_meta("e3"))
+    assert s.maybe_speculate("e2") is not None
+    _age_live_duplicate(s)
+    assert s.maybe_speculate("e3") is None
+
+
+def test_respeculation_waits_for_the_duplicate_floor():
+    """The duplicate is judged on ITS OWN clock: a fresh duplicate (under
+    the floor) is never superseded even while the primary's elapsed time
+    screams straggler."""
+    s = _straggling_state(
+        config=_spec_config(**{"ballista.speculation.min_runtime_ms": "60000"})
+    )
+    s.save_executor_metadata(_meta("e3"))
+    # age the PRIMARY past the (huge) floor so the first launch fires
+    owner, attempt, t0 = s._running_since[("j", 1, 0)]
+    s._running_since[("j", 1, 0)] = (owner, attempt, t0 - 120.0)
+    assert s.maybe_speculate("e2") is not None
+    # the duplicate is brand new: primary still ancient, duplicate under
+    # its own floor -> no re-speculation
+    assert s.maybe_speculate("e3") is None
+
+
+def test_superseded_failure_spares_task_and_live_duplicate():
+    """An abandoned duplicate's failure touches nothing: no retry budget
+    consumed, the primary stays running, and the LIVE successor duplicate
+    stays ledgered."""
+    speculation_stats(reset=True)
+    s = _straggling_state()
+    s.save_executor_metadata(_meta("e3"))
+    assert s.maybe_speculate("e2") is not None
+    _age_live_duplicate(s)
+    assert s.maybe_speculate("e3") is not None
+    failed = _pending("j", 1, 0, attempt=1)
+    failed.speculative = True
+    failed.failed.error = "boom"
+    failed.failed.executor_id = "e2"
+    assert s.accept_task_status(failed) is False
+    cur = s.get_task_status("j", 1, 0)
+    assert cur.WhichOneof("status") == "running" and cur.attempt == 0
+    a = pb.Assignment()
+    a.ParseFromString(s.kv.get(SPEC_KEY))
+    assert a.executor_id == "e3" and a.attempt == 2
+    stats = speculation_stats()
+    assert stats.get("superseded_failed") == 1
+    assert ("j", 1, 0) not in s._spec_superseded  # retired on sight
+
+
+def test_superseded_completion_still_wins():
+    """First completion wins, whoever crosses the line: the ABANDONED
+    duplicate finishing first resolves the task, and the whole episode
+    (ledger + superseded set) closes."""
+    speculation_stats(reset=True)
+    s = _straggling_state()
+    s.save_executor_metadata(_meta("e3"))
+    assert s.maybe_speculate("e2") is not None
+    _age_live_duplicate(s)
+    assert s.maybe_speculate("e3") is not None
+    done = _completed("j", 1, 0, attempt=1, executor="e2", speculative=True)
+    assert s.accept_task_status(done) is True
+    cur = s.get_task_status("j", 1, 0)
+    assert cur.WhichOneof("status") == "completed"
+    assert cur.completed.executor_id == "e2"
+    assert s.kv.get(SPEC_KEY) is None
+    assert ("j", 1, 0) not in s._spec_superseded
+    assert ("j", 1, 0) not in s._spec_launches
+    stats = speculation_stats()
+    assert stats.get("superseded_won") == 1
+    # review regression: the abandoned duplicate's rescue is a speculative
+    # WIN in the effectiveness counters, never a "primary won" loss
+    assert stats.get("won") == 1, stats
+    assert stats.get("lost", 0) == 0, stats
+
+
+def test_requeue_numbers_past_every_minted_speculative_attempt():
+    """A requeue after re-speculation numbers PAST the highest minted
+    duplicate attempt (ledgered AND superseded), so no late report from an
+    abandoned attempt can impersonate the fresh one."""
+    s = _straggling_state()
+    s.save_executor_metadata(_meta("e3"))
+    assert s.maybe_speculate("e2") is not None
+    _age_live_duplicate(s)
+    assert s.maybe_speculate("e3") is not None  # ledger at attempt 2
+    t = s.get_task_status("j", 1, 0)
+    assert s.requeue_task(t, "e1", "upstream lost", limit=5, promote=False)
+    cur = s.get_task_status("j", 1, 0)
+    assert cur.WhichOneof("status") is None and cur.attempt == 3
+
+
+def test_primary_failure_promotes_the_respeculated_duplicate():
+    """Primary dies while the RE-speculated duplicate runs: the promotion
+    path adopts it (attempt 2, on its executor) exactly like a first-round
+    duplicate — no retry budget consumed."""
+    speculation_stats(reset=True)
+    s = _straggling_state()
+    s.save_executor_metadata(_meta("e3"))
+    assert s.maybe_speculate("e2") is not None
+    _age_live_duplicate(s)
+    assert s.maybe_speculate("e3") is not None
+    t = s.get_task_status("j", 1, 0)
+    assert s.requeue_task(t, "e1", "primary lost", limit=3)
+    cur = s.get_task_status("j", 1, 0)
+    assert cur.WhichOneof("status") == "running"
+    assert cur.attempt == 2 and cur.running.executor_id == "e3"
+    assert speculation_stats().get("promoted") == 1
+    # promoted into the ASSIGNMENT ledger; speculation record retired
+    assert s.kv.get(SPEC_KEY) is None
+    assert s.kv.get("/ballista/t/assignments/j/1/0") is not None
+
+
+def test_restart_recovers_respeculated_duplicate(tmp_path):
+    """A scheduler restart mid-re-speculation restores the ledgered
+    attempt-2 duplicate (primary still running attempt 0) and rebuilds the
+    launch bound from attempt arithmetic, so the restarted scheduler never
+    launches past max_attempts either."""
+    kv = SqliteBackend(str(tmp_path / "led.db"))
+    s = _straggling_state(kv=kv)
+    s.save_executor_metadata(_meta("e3"))
+    s.save_executor_metadata(_meta("e4"))
+    assert s.maybe_speculate("e2") is not None
+    _age_live_duplicate(s)
+    assert s.maybe_speculate("e3") is not None
+    s2 = SchedulerState(kv, "t", config=_spec_config())
+    stats = s2.recover()
+    assert stats.get("restart_speculation_restored") == 1, stats
+    assert s2._speculative[("j", 1, 0)][0] == "e3"
+    assert s2._speculative[("j", 1, 0)][1] == 2
+    assert s2._spec_launches[("j", 1, 0)] == 2
+    # at the bound: the restarted scheduler refuses a third launch. It has
+    # no watch entry until statuses flow — seed one (aged, warm rate) so
+    # the monitor WOULD fire if the launch bound did not hold.
+    import heapq
+    import time as _time
+
+    _age_live_duplicate(s2)
+    costmodel.seed(s2._task_run_op("j", 1), 1.0, 0.001, engine="task")
+    s2._running_since[("j", 1, 0)] = ("e1", 0, _time.monotonic() - 5.0)
+    heapq.heappush(
+        s2._running_heap, (s2._running_since[("j", 1, 0)][2], ("j", 1, 0))
+    )
+    assert s2.maybe_speculate("e4") is None
+
+
+def test_respeculation_rescues_double_straggler_end_to_end():
+    """ISSUE 15 satellite acceptance (cluster-level): a seed where BOTH the
+    primary (attempt 0) and the first duplicate (attempt 1) draw slow
+    `task.slow` verdicts, while attempt 2 draws fast — the re-speculated
+    second duplicate rescues the tail: the job finishes well inside the
+    injected delay, a relaunch is counted, and the result is bit-identical
+    to the fault-free run. Needs 3 executors: the re-speculation never
+    lands on the primary's or the live duplicate's executor."""
+    import numpy as np
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    rng = np.random.default_rng(1103)
+    n = 4000
+    table = pa.table({
+        "g": pa.array(rng.integers(0, 23, n), type=pa.int64()),
+        "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+    })
+    sql = "select g, sum(v) as s, count(*) as n from t group by g order by g"
+    base_client = {
+        "ballista.shuffle.partitions": "2",
+        "ballista.cache.results": "false",
+        "ballista.tpu.cost_model_dir": "",
+    }
+    costmodel.reset()
+    cluster = StandaloneCluster(
+        n_executors=3,
+        config=BallistaConfig({
+            "ballista.tpu.cost_model_dir": "",
+            "ballista.speculation.min_runtime_ms": "150",
+            "ballista.speculation.multiplier": "3",
+            "ballista.speculation.max_attempts": "2",
+        }),
+    )
+    try:
+        ctx = BallistaContext(*cluster.scheduler_addr, settings=base_client)
+        ctx.register_record_batches("t", table, n_partitions=6)
+        clean = ctx.sql(sql).collect()
+        ctx.close()
+        st = cluster.scheduler_impl.state
+        coords = []
+        for k, _v in st.kv.get_prefix(st._key("tasks")):
+            tail = k.rsplit("/", 3)
+            coords.append((int(tail[2]), int(tail[3])))
+        by_stage = {}
+        for c in coords:
+            by_stage.setdefault(c[0], []).append(c)
+        # seed injecting EXACTLY one straggler coordinate whose attempts 0
+        # AND 1 are both slow and attempt 2 is fast, in a stage with
+        # enough fast siblings to warm the prediction
+        RATE = 0.12
+        seed = None
+        for cand in range(4000):
+            inj = ChaosInjector(cand, RATE, sites=("task.slow",))
+            slow = [
+                c for c in coords
+                if inj.should_inject("task.slow", f"{c[0]}/{c[1]}@a0")
+            ]
+            if (
+                len(slow) == 1
+                and len(by_stage[slow[0][0]]) >= costmodel.MIN_OBSERVATIONS + 1
+                and inj.should_inject(
+                    "task.slow", f"{slow[0][0]}/{slow[0][1]}@a1"
+                )
+                and not inj.should_inject(
+                    "task.slow", f"{slow[0][0]}/{slow[0][1]}@a2"
+                )
+            ):
+                seed = cand
+                break
+        assert seed is not None, "no qualifying chaos seed in range"
+        speculation_stats(reset=True)
+        ctx2 = BallistaContext(
+            *cluster.scheduler_addr,
+            settings={
+                **base_client,
+                "ballista.chaos.rate": str(RATE),
+                "ballista.chaos.seed": str(seed),
+                "ballista.chaos.sites": "task.slow",
+                "ballista.chaos.slow_ms": "8000",
+            },
+        )
+        ctx2.register_record_batches("t", table, n_partitions=6)
+        t0 = time.perf_counter()
+        chaotic = ctx2.sql(sql).collect()
+        dt = time.perf_counter() - t0
+        ctx2.close()
+        assert chaotic.equals(clean), (
+            chaotic.to_pydict(), clean.to_pydict(),
+        )
+        stats = speculation_stats(reset=True)
+        assert stats.get("launched", 0) >= 2, stats
+        assert stats.get("relaunched", 0) >= 1, stats
+        assert stats.get("won", 0) >= 1, stats
+        # the rescue: both slow attempts carried an 8s injected delay; the
+        # re-speculated attempt finishes far inside it
+        assert dt < 7.0, f"re-speculation did not rescue the tail: {dt:.2f}s"
+    finally:
+        cluster.shutdown()
+        costmodel.reset()

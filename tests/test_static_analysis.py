@@ -256,6 +256,26 @@ def test_failure_rule_batch_site_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_fleet_site_fixture_pair():
+    """ISSUE 15: the new shuffle.store and fleet.scale sites are
+    registered — an unregistered storage site and a computed fleet site
+    name fail lint; the registered-literal shapes (plan-coordinate keys on
+    the storage seams, evaluation-sequence key on the scale decision) are
+    clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_fleet_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "shuffle.publish" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_fleet_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_routing_rule_fixture_pair():
     """ISSUE 10 satellite: a decline-helper call with no routing
     observation in scope and no cold-path annotation fails lint — a
