@@ -58,6 +58,16 @@ BALLISTA_DEVICE_CACHE = "ballista.tpu.device_cache"  # keep encoded columns resi
 # the budget stream (upload, compute, free) instead of pinning — how SF=100
 # fact layouts run on a 16GB-HBM chip
 BALLISTA_TPU_HBM_BUDGET = "ballista.tpu.hbm_budget_bytes"
+# HBM-resident cross-stage exchange (ISSUE 16): a completed shuffle write
+# ALSO registers its pieces in the executor's residency registry so a
+# same-executor consumer resolves them with zero decode and zero re-upload.
+# The disk/storage piece stays the authoritative home — eviction or
+# executor death degrades to the storage -> Flight peer -> lineage ladder.
+BALLISTA_TPU_EXCHANGE = "ballista.tpu.exchange"
+# byte budget for registered exchange pieces per executor process; pieces
+# past it are skipped (or evict colder entries when the cost model says the
+# incomer saves more transfer time than the victims would)
+BALLISTA_TPU_RESIDENCY_BUDGET = "ballista.tpu.residency_budget_bytes"
 BALLISTA_SCAN_CACHE = "ballista.scan.cache"  # host-side decoded-table cache (parquet)
 BALLISTA_SCAN_CACHE_CAP = "ballista.scan.cache_cap_bytes"
 # experimental per-operator device offload (filter/projection masks, PK-FK
@@ -283,6 +293,13 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_FLEET_TARGET_BACKLOG_S: "1.0",
     BALLISTA_DEVICE_CACHE: "true",
     BALLISTA_TPU_HBM_BUDGET: str(12 << 30),
+    # on by default: the exchange tier is bit-identical by construction
+    # (registry entries are the exact batches the authoritative piece
+    # holds) and every degradation path is the pre-existing ladder
+    BALLISTA_TPU_EXCHANGE: "true",
+    # sized well below the HBM budget: exchange pieces are transient
+    # stage-boundary intermediates, not the working set
+    BALLISTA_TPU_RESIDENCY_BUDGET: str(1 << 30),
     BALLISTA_SCAN_CACHE: "true",
     BALLISTA_SCAN_CACHE_CAP: str(4 << 30),
     BALLISTA_TPU_PER_OP: "false",
@@ -491,6 +508,16 @@ class BallistaConfig(Mapping[str, str]):
 
     def tpu_hbm_budget(self) -> int:
         return int(self._settings[BALLISTA_TPU_HBM_BUDGET])
+
+    def tpu_exchange(self) -> bool:
+        """HBM-resident cross-stage exchange tier (ISSUE 16)."""
+        return self._settings[BALLISTA_TPU_EXCHANGE].lower() in (
+            "1", "true", "yes"
+        )
+
+    def residency_budget(self) -> int:
+        """Byte budget for registered exchange pieces per executor."""
+        return int(self._settings[BALLISTA_TPU_RESIDENCY_BUDGET])
 
     def tpu_ingest_workers(self) -> int:
         """Prefetch-stage worker threads; 0 = serial ingest (no threads)."""

@@ -128,6 +128,67 @@ def read_ipc_file(path: str) -> Iterator[pa.RecordBatch]:
             yield r.get_batch(i)
 
 
+class _ExchangeCapture:
+    """Producer-side tee for the HBM-resident exchange tier (ISSUE 16):
+    accumulates the batches streaming through a shuffle write, per output
+    piece, until ballista.tpu.residency_budget_bytes says stop — the write
+    itself is untouched (the disk piece stays the authoritative home), and
+    an over-budget capture is abandoned wholesale rather than registering a
+    partial piece. Published to ops/exchange.py only AFTER the atomic
+    os.replace, so the registry never advertises bytes the piece ladder
+    cannot also produce."""
+
+    def __init__(self, ctx: TaskContext, job_id: str, stage_id: int,
+                 map_partition: int, attempt: int) -> None:
+        self.executor_id = ctx.executor_id
+        self.job_id = job_id
+        self.stage_id = stage_id
+        self.map_partition = map_partition
+        self.attempt = attempt
+        self.budget = ctx.config.residency_budget()
+        self.nbytes = 0
+        self.overflow = False
+        self.pieces: dict = {}  # piece idx -> [RecordBatch]
+
+    @staticmethod
+    def for_task(ctx: TaskContext, job_id: str, stage_id: int,
+                 partition: int) -> "Optional[_ExchangeCapture]":
+        """A capture when the exchange tier is on AND this context runs on
+        a real executor (empty executor_id = in-process/local engine, where
+        a process-global registry would fake same-executor locality)."""
+        if not ctx.executor_id or not ctx.config.tpu_exchange():
+            return None
+        return _ExchangeCapture(ctx, job_id, stage_id, partition, ctx.attempt)
+
+    def add(self, piece: int, batch: pa.RecordBatch) -> None:
+        if self.overflow or not batch.num_rows:
+            return
+        self.nbytes += batch.nbytes
+        if self.nbytes > self.budget:
+            self.overflow = True
+            self.pieces = {}
+            return
+        self.pieces.setdefault(piece, []).append(batch)
+
+    def publish(self, schema: pa.Schema, finals: dict) -> bool:
+        """Register the captured pieces; `finals` maps piece idx -> the
+        published on-disk path. Returns whether anything was kept."""
+        from ballista_tpu.ops import exchange
+        from ballista_tpu.ops.runtime import record_exchange
+
+        if self.overflow:
+            record_exchange("skipped_budget")
+            return False
+        kept = False
+        for piece, batches in self.pieces.items():
+            kept |= exchange.publish(
+                self.executor_id, self.job_id, self.stage_id,
+                self.map_partition, piece, batches, schema,
+                self.attempt, finals[piece], self.budget,
+            )
+        return kept
+
+
 class ShuffleWriterExec(ExecutionPlan):
     """Stage-top operator: executes one input partition of its child and
     materializes it, hash/round-robin split across output partitions."""
@@ -210,15 +271,29 @@ class ShuffleWriterExec(ExecutionPlan):
         pre_publish = (
             self._storage_publish_chaos(partition, ctx) if storage_uri else None
         )
+        capture = _ExchangeCapture.for_task(
+            ctx, self.job_id, self.stage_id, partition
+        )
         if pscheme is None:
+            piece_path = os.path.join(base, "0.arrow")
+
+            def teed() -> Iterator[pa.RecordBatch]:
+                for b in self.input.execute(partition, ctx):
+                    if capture is not None:
+                        capture.add(0, b)
+                    yield b
+
             stats = write_stream_to_disk(
-                self.input.execute(partition, ctx), schema,
-                os.path.join(base, "0.arrow"), codec=codec,
+                teed(), schema, piece_path, codec=codec,
                 pre_publish=pre_publish,
             )
             record_shuffle_tier(
                 "storage_publish" if storage_uri else "local_publish"
             )
+            if capture is not None:
+                # only after the atomic publish: the registry must never
+                # advertise a piece the ladder cannot also produce
+                capture.publish(schema, {0: piece_path})
             return stats
         n_out = pscheme.partition_count()
         writers = []
@@ -247,6 +322,8 @@ class ShuffleWriterExec(ExecutionPlan):
                 for m, piece in enumerate(split_by_partition(batch, ids, n_out)):
                     if piece.num_rows:
                         writers[m][1].write_batch(piece)
+                        if capture is not None:
+                            capture.add(m, piece)
                         total.num_rows += piece.num_rows
                         total.num_bytes += piece.nbytes
                 total.num_batches += 1
@@ -273,6 +350,8 @@ class ShuffleWriterExec(ExecutionPlan):
             record_shuffle_tier(
                 "storage_publish" if storage_uri else "local_publish"
             )
+            if capture is not None:
+                capture.publish(schema, dict(enumerate(finals)))
         return total
 
     def execute(self, partition: int, ctx: TaskContext) -> Iterator[pa.RecordBatch]:
@@ -303,7 +382,14 @@ class ShuffleLocation:
     storage_uri (ISSUE 15): non-empty when the piece set lives in the
     SHARED storage tier — the home is then the path itself, readers resolve
     it from the mount first, and the executor coordinates degrade to a
-    fallback transport rather than the data's single point of failure."""
+    fallback transport rather than the data's single point of failure.
+
+    resident (ISSUE 16): a HINT that the producing executor also registered
+    this piece set in its HBM-resident exchange registry — a same-executor
+    consumer resolves it with zero decode and zero re-upload, and the
+    scheduler prefers placing consumers where their inputs are resident.
+    Never load-bearing: a stale hint (evicted entry, dead producer) just
+    falls through to the authoritative piece ladder."""
 
     def __init__(
         self,
@@ -314,6 +400,8 @@ class ShuffleLocation:
         stage_id: int = 0,
         map_partition: int = 0,
         storage_uri: str = "",
+        resident: bool = False,
+        nbytes: int = 0,
     ) -> None:
         self.executor_id = executor_id
         self.host = host
@@ -322,6 +410,10 @@ class ShuffleLocation:
         self.stage_id = stage_id
         self.map_partition = map_partition
         self.storage_uri = storage_uri
+        self.resident = resident
+        # total piece-set bytes (PartitionStats.num_bytes): sizes the
+        # scheduler's predicted transfer saving for locality ordering
+        self.nbytes = nbytes
 
     def __repr__(self) -> str:
         home = f", storage={self.storage_uri}" if self.storage_uri else ""
@@ -419,6 +511,48 @@ class ShuffleReaderExec(ExecutionPlan):
                     stage_id=loc.stage_id,
                     map_partition=loc.map_partition,
                 ) from e
+        if (
+            ctx.executor_id
+            and loc.executor_id == ctx.executor_id
+            and ctx.config.tpu_exchange()
+        ):
+            # HBM-resident exchange (ISSUE 16): this executor produced the
+            # piece, so resolve its OWN residency registry first — zero
+            # decode, zero re-upload. Every miss (evicted, over budget,
+            # chaos, never registered) falls through to the authoritative
+            # ladder below, bit-identical by construction. The probe keys
+            # on ctx.executor_id, so a StandaloneCluster's co-resident
+            # executors never see false "local" hits.
+            from ballista_tpu.ops import exchange
+            from ballista_tpu.ops.runtime import record_exchange
+
+            if chaos is not None and chaos.should_inject(
+                "exchange.evict",
+                f"{loc.stage_id}/{loc.map_partition}/piece{piece_idx}"
+                f"@a{ctx.attempt}",
+            ):
+                # seeded eviction between produce and consume: drop the
+                # entry and take the ladder — a cache going cold is never
+                # a task failure, so zero retries by construction
+                from ballista_tpu.ops.runtime import record_recovery
+
+                record_recovery("chaos_injected")
+                if exchange.evict(
+                    ctx.executor_id, ctx.job_id, loc.stage_id,
+                    loc.map_partition, piece_idx,
+                ):
+                    record_exchange("evicted_chaos")
+            hit = exchange.resolve(
+                ctx.executor_id, ctx.job_id, loc.stage_id,
+                loc.map_partition, piece_idx,
+            )
+            if hit is not None:
+                batches, nbytes = hit
+                record_exchange("reupload_skipped")
+                record_exchange("h2d_bytes_saved", nbytes)
+                yield from batches
+                return
+            record_exchange("miss")
         if loc.storage_uri:
             # disaggregated tier (ISSUE 15): the piece's home is a PATH —
             # resolve it from the shared mount first. A shuffle.store READ

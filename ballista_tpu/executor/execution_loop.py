@@ -290,6 +290,11 @@ class PollLoop:
                     if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
                         shutil.rmtree(path, ignore_errors=True)
                         removed += 1
+                        # the exchange registry (ISSUE 16) must not outlive
+                        # the authoritative pieces it mirrors
+                        from ballista_tpu.ops import exchange
+
+                        exchange.evict_job(job_dir)
                 except OSError:
                     continue
         if removed:
@@ -532,6 +537,9 @@ class PollLoop:
                     flight_shuffle_fetcher, config=cfg
                 ),
                 attempt=task.attempt,
+                # keys the HBM-resident exchange registry (ISSUE 16) per
+                # executor, so co-resident executors never cross-hit
+                executor_id=self.metadata.id,
             )
             return task, status, plan, ctx
         except Exception as e:
@@ -598,6 +606,15 @@ class PollLoop:
             status.completed.path = base
             if storage_uri:
                 status.completed.storage_uri = storage_uri
+            # advertise HBM residency (ISSUE 16): the scheduler folds this
+            # into the consumer stage's ShuffleLocations (locality-aware
+            # assignment) — a HINT only, the piece on disk stays the home
+            from ballista_tpu.ops import exchange
+
+            if exchange.stage_resident(
+                self.metadata.id, pid.job_id, pid.stage_id, pid.partition_id
+            ):
+                status.completed.resident = True
             status.completed.stats.num_rows = stats.num_rows
             status.completed.stats.num_batches = stats.num_batches
             status.completed.stats.num_bytes = stats.num_bytes

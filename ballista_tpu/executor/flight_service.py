@@ -46,6 +46,25 @@ class BallistaFlightService(flight.FlightServerBase):
         which = action.WhichOneof("action_type")
         if which == "fetch_partition":
             path = self._resolve_work_path(action.fetch_partition.path)
+            if self.config.tpu_exchange():
+                # HBM-resident exchange (ISSUE 16): serve a registered
+                # piece straight from memory instead of re-reading it off
+                # disk — the same batches the authoritative IPC file holds,
+                # so the stream is bit-identical to the file read. Confined
+                # FIRST (_resolve_work_path above): the registry only ever
+                # indexes paths this executor published itself, so a miss
+                # falls through to the ordinary confined file read.
+                from ballista_tpu.ops import exchange
+                from ballista_tpu.ops.runtime import record_exchange
+
+                hit = exchange.resolve_path(path) or exchange.resolve_path(
+                    action.fetch_partition.path
+                )
+                if hit is not None:
+                    schema, batches, nbytes = hit
+                    record_exchange("served_from_registry")
+                    record_exchange("d2h_bytes_saved", nbytes)
+                    return flight.GeneratorStream(schema, iter(batches))
             if not os.path.isfile(path):
                 raise flight.FlightServerError(f"no such shuffle piece: {path}")
             # batch-at-a-time so a fetch never materializes the whole

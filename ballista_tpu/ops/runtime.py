@@ -933,6 +933,36 @@ def shuffle_tier_stats(reset: bool = False) -> Dict[str, int]:
     return out
 
 
+# accumulated HBM-resident exchange events (ISSUE 16): published /
+# publish_bytes = pieces registered in the residency registry after their
+# authoritative disk publish, reupload_skipped / h2d_bytes_saved = consumer
+# resolutions served straight from the registry (no decode, no re-upload),
+# served_from_registry / d2h_bytes_saved = Flight FetchPartition streams
+# served from memory instead of re-reading the piece off disk,
+# skipped_budget / evicted_budget = budget pressure outcomes at publish,
+# evicted_chaos = exchange.evict verdicts, locality_preferred = scheduler
+# assignments reordered toward the executor advertising residency, miss =
+# registry probes that fell through to the piece ladder. Same in-process
+# accumulator pattern as recovery/shuffle-tier above.
+_exchange_lock = make_lock("ops.runtime._exchange_lock")
+# guarded-by: _exchange_lock
+_exchange: Dict[str, int] = {}  # event -> count
+
+
+def record_exchange(event: str, n: int = 1) -> None:
+    with _exchange_lock:
+        _exchange[event] = _exchange.get(event, 0) + int(n)
+
+
+def exchange_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated exchange-tier counters."""
+    with _exchange_lock:
+        out = dict(_exchange)
+        if reset:
+            _exchange.clear()
+    return out
+
+
 # accumulated elastic-fleet events (ISSUE 15): autoscaler evaluations and
 # the scale actions they took (scale_up / scale_down by executor count,
 # scale_chaos_skipped = fleet.scale-torn decisions, drain_completed /

@@ -293,6 +293,31 @@ class SpmdJoinExec(ExecutionPlan):
                 left, right, bcodes, pcodes, kind="step_aside", reason=why
             )
 
+        # admission rides the cost model (ISSUE 16 satellite): with BOTH
+        # the mesh exchange and the inline host join warm for this shape,
+        # skip the mesh — and its program compile — when the model says
+        # the host wins. Cold on either side → admit, exactly the static
+        # ladder above; the mesh path's check_mispredict below keeps its
+        # rate honest, and join.host keeps averaging on every inline run,
+        # so a side that grows past the host's sweet spot flips back.
+        mesh_units = n_dev * n_dev * C_p * width
+        mesh_pred = costmodel.predict("join.mesh", mesh_units)
+        host_pred = costmodel.predict(
+            "join.host", len(bcodes) + len(pcodes), engine="host"
+        )
+        if (
+            mesh_pred is not None
+            and host_pred is not None
+            and mesh_pred > host_pred
+        ):
+            return self._host_join_collected(
+                left, right, bcodes, pcodes, kind="host_declined",
+                reason=(
+                    f"cost model: mesh {mesh_pred:.4f}s > "
+                    f"host {host_pred:.4f}s"
+                ),
+            )
+
         program = self._get_program(
             mesh, n_dev, C_l * n_dev, C_p * n_dev, width,
             want_left_bitmap=join.join_type == JoinType.LEFT,
@@ -304,8 +329,7 @@ class SpmdJoinExec(ExecutionPlan):
         # mesh rate tracks the current machine too.
         import time as _time
 
-        mesh_units = n_dev * n_dev * C_p * width
-        predicted = costmodel.predict("join.mesh", mesh_units)
+        predicted = mesh_pred
         t_mesh0 = _time.perf_counter()
         outs = program(
             jnp.asarray(lc), jnp.asarray(lr), jnp.asarray(pc_), jnp.asarray(pr)

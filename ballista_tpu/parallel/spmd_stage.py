@@ -229,14 +229,39 @@ class SpmdAggregateExec(ExecutionPlan):
         if ctx.backend != "tpu":
             yield from self._execute_host(ctx)
             return
-        try:
-            # mesh aggregate cost feeds the same store the single-chip
-            # ladder consults (ISSUE 10), keyed on this stage's identity;
-            # the decision lands in the routing accumulator either way
-            from ballista_tpu.ops import costmodel
+        # mesh aggregate cost feeds the same store the single-chip ladder
+        # consults (ISSUE 10), keyed on this stage's identity; the decision
+        # lands in the routing accumulator either way
+        from ballista_tpu.ops import costmodel
 
-            costmodel.configure(ctx.config)
-            op = "mesh.agg|" + self.fingerprint()[:12]
+        costmodel.configure(ctx.config)
+        op = "mesh.agg|" + self.fingerprint()[:12]
+        host_op = "mesh.agg.host|" + self.fingerprint()[:12]
+        # admission rides the cost model (ISSUE 16 satellite): with BOTH
+        # paths warm for this stage shape and the mesh predicted slower
+        # (compile + collective overhead on small inputs), decline to the
+        # host up front instead of paying the launch to learn it again.
+        # Cold on either side → admit, exactly the pre-model ladder; the
+        # host run below stays predictive, so a stage that outgrew its
+        # host rate grossly mispredicts, re-tiers, and earns the mesh
+        # back on its next admission check.
+        mesh_pred = costmodel.predict(op, 1.0)
+        host_pred = costmodel.predict(host_op, 1.0, engine="host")
+        if (
+            mesh_pred is not None
+            and host_pred is not None
+            and mesh_pred > host_pred
+        ):
+            from ballista_tpu.ops.runtime import record_routing
+
+            record_routing("host", "mesh.agg", mesh_pred, None)
+            tracing.incr("spmd.host_declined")
+            self.last_path = "host"
+            with costmodel.timed(host_op, engine="host"):
+                out = collect_all(self.subplan, ctx)
+            yield from batch_table(out, ctx.batch_size)
+            return
+        try:
             with costmodel.timed(op, routing_op="mesh.agg"):
                 out = self._execute_mesh(ctx)
             self.last_path = "mesh"
@@ -261,7 +286,12 @@ class SpmdAggregateExec(ExecutionPlan):
 
             record_routing("host", "mesh.agg")
             self.last_path = "host"
-            yield from self._execute_host(ctx)
+            # the forced fallback still warms the host-side rate the
+            # admission check above compares against (predictive=False: a
+            # run the mesh error forced must not re-tier on surprise)
+            with costmodel.timed(host_op, engine="host", predictive=False):
+                out = collect_all(self.subplan, ctx)
+            yield from batch_table(out, ctx.batch_size)
             return
         yield from batch_table(out, ctx.batch_size)
 

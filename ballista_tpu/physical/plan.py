@@ -59,6 +59,7 @@ class TaskContext:
         work_dir: Optional[str] = None,
         job_id: str = "",
         attempt: int = 0,
+        executor_id: str = "",
     ) -> None:
         self.config = config or BallistaConfig()
         # shuffle_fetcher: callable(PartitionLocation) -> Iterator[RecordBatch];
@@ -69,6 +70,12 @@ class TaskContext:
         # which attempt of the task this context serves: part of the chaos
         # injection key so a retried attempt draws a fresh fault verdict
         self.attempt = attempt
+        # which executor runs this task: the HBM-resident exchange registry
+        # (ops/exchange.py, ISSUE 16) keys entries per executor, so a
+        # StandaloneCluster's co-resident executors never see false "local"
+        # hits. Empty (the in-process/local-engine default) disables the
+        # exchange tier for this context.
+        self.executor_id = executor_id
 
     @property
     def batch_size(self) -> int:

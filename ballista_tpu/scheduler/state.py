@@ -29,6 +29,8 @@ normal retry/lineage path.
 from __future__ import annotations
 
 import logging
+import os
+import shutil
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -38,7 +40,11 @@ from ballista_tpu.distributed.planner import (
     find_unresolved_shuffles,
     remove_unresolved_shuffles,
 )
-from ballista_tpu.distributed.stages import ShuffleLocation, ShuffleWriterExec
+from ballista_tpu.distributed.stages import (
+    ShuffleLocation,
+    ShuffleReaderExec,
+    ShuffleWriterExec,
+)
 from ballista_tpu.proto import ballista_pb2 as pb
 from ballista_tpu.scheduler.kv import KvBackend
 from ballista_tpu.serde.physical import phys_plan_from_proto, phys_plan_to_proto
@@ -90,6 +96,12 @@ def _record_routing(engine: str, op: str = "", predicted_s=None,
     from ballista_tpu.ops.runtime import record_routing
 
     record_routing(engine, op, predicted_s, observed_s)
+
+
+def _record_shuffle_tier(event: str, n: int = 1) -> None:
+    from ballista_tpu.ops.runtime import record_shuffle_tier
+
+    record_shuffle_tier(event, n)
 
 
 def _attempts_error(t: pb.TaskStatus) -> str:
@@ -755,10 +767,19 @@ class SchedulerState:
             if self._chaos is not None:
                 self._chaos.maybe_fail("cache.put", f"fp:{fingerprint[:16]}")
             self._result_cache_evict_for(fingerprint)
-            self.kv.put(
-                self._key("resultcache", fingerprint),
-                entry.SerializeToString(),
-            )
+            key = self._key("resultcache", fingerprint)
+            # an overwrite orphans the PRIOR job's result pieces: sweep
+            # them once the new entry is durably in (ISSUE 16 GC), keeping
+            # anything the replacement still points at
+            prior = self.kv.get(key)
+            self.kv.put(key, entry.SerializeToString())
+            if prior is not None:
+                self._gc_cached_result(
+                    prior,
+                    keep_uris=[
+                        pl.storage_uri for pl in entry.partition_location
+                    ],
+                )
         except ChaosInjected:
             _record_recovery("chaos_injected")
             _record_tenancy("cache_put_torn")
@@ -769,8 +790,11 @@ class SchedulerState:
         return True
 
     def _result_cache_delete(self, fingerprint: str) -> None:
-        """Delete one entry, keeping the best-effort count in step."""
-        self.kv.delete(self._key("resultcache", fingerprint))
+        """Delete one entry, keeping the best-effort count in step (and
+        sweeping its storage-homed result pieces, ISSUE 16 GC)."""
+        key = self._key("resultcache", fingerprint)
+        self._gc_cached_result(self.kv.get(key))
+        self.kv.delete(key)
         if self._rc_count is not None:
             self._rc_count = max(0, self._rc_count - 1)
 
@@ -809,11 +833,14 @@ class SchedulerState:
             except Exception:
                 self.kv.delete(k)  # unreadable entry: reclaim the slot
                 continue
-            live.append((e.last_hit or e.created_at, k))
+            live.append((e.last_hit or e.created_at, k, v))
         evicted = 0
         if len(live) >= cap:
-            live.sort()
-            for _recency, k in live[: len(live) - cap + 1]:
+            live.sort(key=lambda t: t[:2])
+            for _recency, k, v in live[: len(live) - cap + 1]:
+                # evicted entry = last reference to its storage-homed
+                # result pieces (ISSUE 16 GC)
+                self._gc_cached_result(v)
                 self.kv.delete(k)
                 evicted += 1
                 _record_tenancy("cache_evicted")
@@ -880,6 +907,105 @@ class SchedulerState:
     def result_cache_invalidate(self, fingerprint: str) -> None:
         self._result_cache_delete(fingerprint)
         _record_tenancy("cache_invalidated")
+
+    # -- shared-store GC (ISSUE 16 satellite) -------------------------------
+    @staticmethod
+    def _gc_piece_dir(uri: str, stage_id: int, partition: int,
+                      job_id: Optional[str] = None) -> int:
+        """rmtree ONE published piece-set dir — but only when the path's
+        tail spells the scheduler-known plan coordinates
+        (<job>/)<stage>/<partition>, the layout shuffle_output_base
+        publishes under. The uri is executor-reported: the structural
+        check means a report can only ever steer a delete to the piece
+        home it announced at completion, never an arbitrary host path.
+        Empty stage/job parents prune with it."""
+        d = os.path.normpath(uri)
+        tail = [str(stage_id), str(partition)]
+        if job_id is not None:
+            tail.insert(0, job_id)
+        if d.split(os.sep)[-len(tail):] != tail or not os.path.isdir(d):
+            return 0
+        shutil.rmtree(d, ignore_errors=True)
+        for parent in (os.path.dirname(d),
+                       os.path.dirname(os.path.dirname(d))):
+            try:
+                os.rmdir(parent)
+            except OSError:
+                break
+        return 1
+
+    def _gc_shared_store_job(
+        self, job_id: str, keep_final: Optional[int], tasks
+    ) -> int:
+        """Sweep a terminal job's storage-homed shuffle pieces — the dirs
+        its own completed tasks REPORTED as their storage_uri homes, so
+        per-job tier opt-ins GC without the scheduler needing the mount
+        configured itself.
+
+        Refcount view: every intermediate stage's pieces are referenced
+        only by the job's own downstream tasks, so the job's terminal
+        transition IS the refcount release for them — they sweep
+        immediately. The FINAL stage is still referenced by the client
+        fetch and (when fingerprintable) the result cache, so it stays
+        behind `keep_final` until its cache entry leaves the cache
+        (_gc_cached_result); never-cached finals are the ISSUE 15 TTL
+        sweeper's to reclaim — it stays on as the backstop for everything
+        this eager path misses. A failed job releases every stage at once
+        (keep_final None), and a completed-job restart
+        (restart_completed_job) recomputes swept intermediates through
+        the ordinary fetch_failed lineage ladder."""
+        swept = 0
+        for t in tasks:
+            if t.WhichOneof("status") != "completed":
+                continue
+            uri = t.completed.storage_uri
+            stage = t.partition_id.stage_id
+            if not uri or (keep_final is not None and stage == keep_final):
+                continue
+            swept += self._gc_piece_dir(
+                uri, stage, t.partition_id.partition_id, job_id=job_id
+            )
+        if swept:
+            _record_shuffle_tier("gc_stage_swept", swept)
+            log.info(
+                "shared-store GC: swept %d piece dir(s) of job %s", swept,
+                job_id,
+            )
+        return swept
+
+    def _gc_cached_result(self, raw, keep_uris=()) -> None:
+        """A result-cache entry leaving the cache (TTL expiry, LRU
+        eviction, invalidation, or overwrite by a newer same-fingerprint
+        job) drops the last reference to its storage-homed final-stage
+        pieces — sweep them (same structural check as above; the job
+        component is unknown here, the stage/partition coordinates are
+        the entry's own). `raw` is the serialized ResultCacheEntry
+        (None/unparseable = nothing to do); `keep_uris` names
+        storage_uris a replacing entry still references (the overwrite
+        case must not sweep its successor's pieces). Work-dir-homed
+        locations are untouched — executor work dirs are their owners'
+        to reclaim."""
+        if not raw:
+            return
+        entry = pb.ResultCacheEntry()
+        try:
+            entry.ParseFromString(raw)
+        except Exception:
+            return
+        keep = {os.path.normpath(u) for u in keep_uris if u}
+        swept = 0
+        for pl in entry.partition_location:
+            uri = pl.storage_uri
+            if not uri or os.path.normpath(uri) in keep:
+                continue
+            swept += self._gc_piece_dir(
+                uri, pl.partition_id.stage_id, pl.partition_id.partition_id
+            )
+        if swept:
+            _record_shuffle_tier("gc_result_swept", swept)
+            log.info(
+                "shared-store GC: swept %d cached-result piece dir(s)", swept
+            )
 
     # -- stage plans ----------------------------------------------------------
     def stage_job_plan(self, job_id: str, attempt: int = 0) -> JobPlanBatch:
@@ -1586,10 +1712,58 @@ class SchedulerState:
                         # readers resolve it from the mount (host/port stay
                         # the fallback transport while the producer lives)
                         storage_uri=t.completed.storage_uri,
+                        # HBM-resident exchange hint + size (ISSUE 16):
+                        # advisory — a consumer landing elsewhere (or after
+                        # eviction) just walks the ordinary piece ladder
+                        resident=t.completed.resident,
+                        nbytes=t.completed.stats.num_bytes,
                     )
                 )
             locations[u.stage_id] = locs
         return remove_unresolved_shuffles(plan, locations) if unresolved else plan
+
+    def _locality_partition_order(
+        self, bound, parts, executor_id: str
+    ) -> Tuple[list, Set]:
+        """Visit order for a chosen stage's pending partitions, preferring
+        partitions whose HBM-resident shuffle inputs live on THIS executor
+        (ISSUE 16). Strictly a reorder WITHIN the stage the fair-share /
+        SLO / blacklist machinery already chose — tenant order, quota, and
+        the per-task executor blacklist all apply before and after exactly
+        as without residency. The preference is cost-model-sized: each
+        resident input contributes its predicted readback+re-upload saving
+        (exchange.predicted_transfer_saving_s over the producer-reported
+        piece bytes), so a partition backed by large resident pieces beats
+        one backed by crumbs. Only identity readers differentiate
+        partitions (consumer p reads exactly map output p); a hash reader
+        consumes a slice of EVERY map output, so its saving is uniform
+        across partitions and cannot reorder anything. Ties (and the
+        no-residency case) keep the deterministic sorted-by-str order the
+        fair-share identity tests pin. Returns (ordered partitions, the
+        set with a positive predicted saving on this executor)."""
+        saving: Dict[object, float] = {}
+        stack = [bound]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ShuffleReaderExec) and node.identity:
+                from ballista_tpu.ops import exchange
+
+                for p in parts:
+                    if not isinstance(p, int) or p >= len(node.locations):
+                        continue
+                    loc = node.locations[p]
+                    if loc.resident and loc.executor_id == executor_id:
+                        saving[p] = saving.get(p, 0.0) + (
+                            exchange.predicted_transfer_saving_s(loc.nbytes)
+                        )
+            # getattr: scheduler tests bind stub plans with no tree API —
+            # no residency signal there means no reorder, by construction
+            stack.extend(getattr(node, "children", list)())
+        base = sorted(parts, key=str)
+        preferred = {p for p, s in saving.items() if s > 0.0}
+        if not preferred:
+            return base, preferred
+        return sorted(base, key=lambda p: -saving.get(p, 0.0)), preferred
 
     # -- speculative execution (ISSUE 11) -----------------------------------
     def _task_run_op(self, job_id: str, stage_id: int) -> str:
@@ -2302,7 +2476,10 @@ class SchedulerState:
             bound = self._bound_stage_plan(job_id, stage_id, idx)
             if bound is None:
                 continue
-            for partition in sorted(parts, key=str):
+            ordered, resident_pref = self._locality_partition_order(
+                bound, parts, executor_id
+            )
+            for partition in ordered:
                 # re-verify from the KV before claiming: the index is local
                 # to this SchedulerState; a peer scheduler (or an expired
                 # write) must not lead to a double assignment
@@ -2338,6 +2515,11 @@ class SchedulerState:
                 running = pb.TaskStatus()
                 running.CopyFrom(current)  # keep attempt + history
                 running.running.executor_id = executor_id
+                if partition in resident_pref:
+                    # the pick landed where its inputs are HBM-resident
+                    from ballista_tpu.ops.runtime import record_exchange
+
+                    record_exchange("locality_preferred")
                 self.save_task_status(running)
                 self._ledger_put(
                     (job_id, stage_id, partition), executor_id, running.attempt
@@ -2503,6 +2685,7 @@ class SchedulerState:
                 pl.path = t.completed.path
                 pl.partition_stats.CopyFrom(t.completed.stats)
                 pl.storage_uri = t.completed.storage_uri
+                pl.resident = t.completed.resident
         else:
             status.running.SetInParent()
             # per-partition completion notifications (ISSUE 8): publish the
@@ -2527,8 +2710,22 @@ class SchedulerState:
                 pl.path = t.completed.path
                 pl.partition_stats.CopyFrom(t.completed.stats)
                 pl.storage_uri = t.completed.storage_uri
+                pl.resident = t.completed.resident
         self.save_job_metadata(job_id, status)
-        if status.WhichOneof("status") == "completed":
+        which_new = status.WhichOneof("status")
+        if which_new in ("completed", "failed"):
+            # shared-store GC (ISSUE 16 satellite): the terminal transition
+            # happens exactly ONCE (the already-terminal early return above
+            # guards re-entry), so this is the refcount-release point for
+            # the job's intermediate shuffle pieces — completed keeps its
+            # final stage for the client/result cache, failed releases all
+            self._gc_shared_store_job(
+                job_id,
+                max(t.partition_id.stage_id for t in tasks)
+                if which_new == "completed" else None,
+                tasks,
+            )
+        if which_new == "completed":
             self._note_job_slo(job_id)
             # publish into the plan-fingerprint result cache (ISSUE 7).
             # jobfp/{job} exists only when the submission was fingerprintable

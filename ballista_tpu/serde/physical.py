@@ -223,6 +223,11 @@ def phys_plan_to_proto(plan: ExecutionPlan) -> pb.PhysicalPlanNode:
             # disaggregated tier (ISSUE 15): the path-home rides the wire so
             # the executing reader resolves storage-first
             pl.storage_uri = loc.storage_uri
+            # HBM-resident exchange hint + piece size (ISSUE 16): the size
+            # lets the consumer-side cost model price the transfer the
+            # resident hit would skip
+            pl.resident = loc.resident
+            pl.partition_stats.num_bytes = loc.nbytes
         n.shuffle_reader.schema_ipc = schema_to_ipc(plan.schema())
         n.shuffle_reader.num_partitions = plan.num_partitions
         n.shuffle_reader.identity = plan.identity
@@ -410,6 +415,8 @@ def phys_plan_from_proto(n: pb.PhysicalPlanNode) -> ExecutionPlan:
                 stage_id=pl.partition_id.stage_id,
                 map_partition=pl.partition_id.partition_id,
                 storage_uri=pl.storage_uri,
+                resident=pl.resident,
+                nbytes=pl.partition_stats.num_bytes,
             )
             for pl in n.shuffle_reader.partition_locations
         ]

@@ -90,6 +90,18 @@ SITES = (
                              # evaluation draws fresh (sequence-keyed). Never
                              # tears a drain mid-way: the decision aborts
                              # BEFORE any executor is touched.
+    "exchange.evict",        # HBM-resident exchange registry (ISSUE 16,
+                             # distributed/stages.py). A verdict at CONSUME
+                             # time — keyed on plan coordinates + the
+                             # consuming attempt, like flight.fetch — evicts
+                             # the produced-but-not-yet-consumed registry
+                             # entry, rehearsing "residency lost between
+                             # produce and consume": the reader silently
+                             # falls through to the authoritative piece
+                             # (storage -> Flight peer -> lineage ladder),
+                             # bit-identical by construction and with ZERO
+                             # task retries (nothing failed, only a cache
+                             # went cold).
     "task.slow",             # deterministic straggler injection (ISSUE 11,
                              # execution_loop.py): a task whose (stage,
                              # partition, attempt) coordinate draws a slow

@@ -1506,6 +1506,103 @@ def _elastic_scenario() -> dict | None:
     return result
 
 
+def _exchange_scenario() -> dict | None:
+    """HBM-resident exchange scenario (ISSUE 16): a 2-stage aggregation on
+    one executor, run three ways — exchange ON (the reduce side resolves
+    its local map pieces from the in-process registry: zero decode, zero
+    re-upload), exchange OFF (the authoritative Arrow-piece ladder, also
+    the bit-identity oracle), and exchange ON under seeded exchange.evict
+    chaos (every consume-time probe torn: reads degrade to the ladder with
+    ZERO task retries). Reports the skip/savings counters and a digest of
+    the result bytes so CI can assert all three runs are bit-identical.
+
+    Knobs: BENCH_EXCHANGE_ROWS (default 60000), BENCH_EXCHANGE_SEED
+    (chaos seed, default 5)."""
+    import hashlib
+
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops import exchange
+    from ballista_tpu.ops.runtime import exchange_stats, recovery_stats
+
+    n_rows = int(os.environ.get("BENCH_EXCHANGE_ROWS", "60000"))
+    chaos_seed = int(os.environ.get("BENCH_EXCHANGE_SEED", "5"))
+    rng = np.random.default_rng(16)
+    table = pa.table({
+        "g": pa.array(rng.integers(0, 13, n_rows), type=pa.int64()),
+        "v": pa.array(np.round(rng.uniform(-100, 100, n_rows), 2)),
+        "q": pa.array(rng.integers(1, 50, n_rows), type=pa.int64()),
+    })
+    sql = ("select g, sum(v) as s, min(q) as mn, max(q) as mx, count(*) as n "
+           "from t group by g order by g")
+
+    def run(settings):
+        exchange.reset()
+        exchange_stats(reset=True)
+        recovery_stats(reset=True)
+        cluster = StandaloneCluster(n_executors=1)
+        try:
+            ctx = BallistaContext(*cluster.scheduler_addr, settings={
+                "ballista.shuffle.partitions": "8",
+                "ballista.cache.results": "false",
+                **settings,
+            })
+            ctx.register_record_batches("t", table, n_partitions=8)
+            t0 = time.perf_counter()
+            out = ctx.sql(sql).collect()
+            dt = time.perf_counter() - t0
+            ctx.close()
+        finally:
+            cluster.shutdown()
+        return out, dt, exchange_stats(reset=True), recovery_stats(reset=True)
+
+    def digest(tbl):
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, tbl.schema) as w:
+            w.write_table(tbl)
+        return hashlib.sha256(sink.getvalue().to_pybytes()).hexdigest()[:16]
+
+    on_out, on_dt, on_stats, on_rec = run({})
+    off_out, off_dt, off_stats, _ = run({"ballista.tpu.exchange": "false"})
+    chaos_out, chaos_dt, chaos_stats, chaos_rec = run({
+        "ballista.chaos.rate": "1.0",
+        "ballista.chaos.seed": str(chaos_seed),
+        "ballista.chaos.sites": "exchange.evict",
+    })
+
+    bit_identical = on_out.equals(off_out) and chaos_out.equals(off_out)
+    result = {
+        "rows": n_rows,
+        "digest": digest(off_out),
+        "bit_identical": bit_identical,
+        "on_ms": round(on_dt * 1000, 1),
+        "off_ms": round(off_dt * 1000, 1),
+        "chaos_ms": round(chaos_dt * 1000, 1),
+        "published": int(on_stats.get("published", 0)),
+        "reupload_skipped": int(on_stats.get("reupload_skipped", 0)),
+        "h2d_bytes_saved": int(on_stats.get("h2d_bytes_saved", 0)),
+        "served_from_registry": int(on_stats.get("served_from_registry", 0)),
+        "d2h_bytes_saved": int(on_stats.get("d2h_bytes_saved", 0)),
+        "off_stats_empty": off_stats == {},
+        "task_retries": int(on_rec.get("task_retry", 0)),
+        "chaos": {
+            "evicted_chaos": int(chaos_stats.get("evicted_chaos", 0)),
+            "miss": int(chaos_stats.get("miss", 0)),
+            "injected": int(chaos_rec.get("chaos_injected", 0)),
+            "task_retries": int(chaos_rec.get("task_retry", 0)),
+        },
+    }
+    print(f"[exchange] reupload_skipped={result['reupload_skipped']} "
+          f"h2d_bytes_saved={result['h2d_bytes_saved']} "
+          f"d2h_bytes_saved={result['d2h_bytes_saved']} "
+          f"chaos_evicted={result['chaos']['evicted_chaos']} "
+          f"bit_identical={bit_identical}", file=sys.stderr)
+    return result
+
+
 def _routing_scenario() -> dict | None:
     """Adaptive-execution smoke (ISSUE 10): an in-process skewed join whose
     build-key multiplicity sits past the static admission ladder, run cold,
@@ -1600,6 +1697,10 @@ def main() -> None:
     if os.environ.get("BENCH_ELASTIC_ONLY"):
         # elastic-fleet scenario only: runs without a reachable device
         print(json.dumps({"elastic": _elastic_scenario()}))
+        return
+    if os.environ.get("BENCH_EXCHANGE_ONLY"):
+        # HBM-resident exchange scenario only: runs without a reachable device
+        print(json.dumps({"exchange": _exchange_scenario()}))
         return
     _probe_device()
     ensure_data(SF)

@@ -369,3 +369,45 @@ assert rec["compile_trace"] == 0, f"warm sweep traced: {rec}"
 assert rec["compile_hit_rate"] == 1.0, rec
 print("latency smoke OK:", rec["sweep"][0])
 PY
+
+# HBM-resident exchange bench smoke (ISSUE 16): the 2-stage aggregation
+# must actually SKIP re-uploads on the same-executor consume path
+# (registry hits, not ladder reads), stay bit-identical to the
+# exchange-off oracle, and degrade to the ladder with zero task retries
+# when every consume-time probe is torn by seeded exchange.evict chaos.
+JAX_PLATFORMS=cpu BENCH_EXCHANGE_ONLY=1 python bench.py \
+    > /tmp/_ballista_exchange_smoke.json
+python - /tmp/_ballista_exchange_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["exchange"]
+assert rec is not None, "exchange scenario returned no record"
+assert rec["bit_identical"], "exchange tier changed results"
+assert rec["reupload_skipped"] >= 1, rec
+assert rec["h2d_bytes_saved"] > 0, rec
+assert rec["off_stats_empty"], "exchange-off run touched the registry"
+assert rec["task_retries"] == 0, rec
+ch = rec["chaos"]
+assert ch["evicted_chaos"] >= 1, ch
+assert ch["injected"] >= 1, ch
+assert ch["task_retries"] == 0, "registry loss caused task retries"
+print("exchange smoke OK:",
+      {"reupload_skipped": rec["reupload_skipped"],
+       "h2d_bytes_saved": rec["h2d_bytes_saved"],
+       "d2h_bytes_saved": rec["d2h_bytes_saved"],
+       "chaos_evicted": ch["evicted_chaos"],
+       "digest": rec["digest"]})
+PY
+
+# full tier-1 under the dynamic lock witness (ISSUE 16 satellite): every
+# fast test — the exchange registry, scheduler GC, chaos ladders, SPMD
+# admission included — runs with each project lock asserting the declared
+# order at acquisition, then --check-witness fails the tier on any runtime
+# edge the static analyzer missed. This is the broadest coverage the
+# witness gets: the targeted smokes above arm single paths; this lane arms
+# everything tier-1 reaches.
+rm -f /tmp/_ballista_witness_t1.json
+JAX_PLATFORMS=cpu BALLISTA_LOCK_WITNESS=1 \
+    BALLISTA_LOCK_WITNESS_OUT=/tmp/_ballista_witness_t1.json \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+python -m dev.analysis --check-witness /tmp/_ballista_witness_t1.json ballista_tpu

@@ -25,7 +25,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-STR, U32, MSG = 9, 13, 11  # FieldDescriptorProto.Type
+STR, U32, MSG, BOOL = 9, 13, 11, 8  # FieldDescriptorProto.Type
 OPT, REP = 1, 3  # FieldDescriptorProto.Label
 
 _HEADER = '''# -*- coding: utf-8 -*-
@@ -323,6 +323,25 @@ def edit_issue15_disaggregated_shuffle(fdp) -> None:
     add_field(msgs["PartitionLocation"], "storage_uri", 5, STR)
 
 
+def edit_issue16_resident_exchange(fdp) -> None:
+    """ISSUE 16: HBM-resident cross-stage exchange.
+
+    Adds (wire-compatible field additions):
+    - CompletedTask.resident: the producing executor ALSO registered this
+      task's shuffle pieces in its in-memory exchange registry — a HINT
+      only (the disk/storage piece stays the authoritative home); the
+      scheduler folds it into consumer-stage shuffle locations.
+    - PartitionLocation.resident: the same hint on every location record,
+      so bound shuffle-reader plans carry it to executors and the
+      scheduler's locality preference can read it off the bound plan. A
+      stale hint (evicted entry, dead producer) silently degrades to the
+      storage -> Flight peer -> lineage ladder.
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+    add_field(msgs["CompletedTask"], "resident", 5, BOOL)
+    add_field(msgs["PartitionLocation"], "resident", 6, BOOL)
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
@@ -333,6 +352,7 @@ APPLIED = [
     edit_issue11_speculation,
     edit_issue13_shared_scan,
     edit_issue15_disaggregated_shuffle,
+    edit_issue16_resident_exchange,
 ]
 
 

@@ -759,6 +759,12 @@ def test_fuzz_shared_tier_chaos(seed, tmp_path):
         "ballista.shuffle.partitions": "4",
         "ballista.shuffle.tier": "shared",
         "ballista.shuffle.dir": shared,
+        # this slice exercises the STORAGE ladder under torn publishes —
+        # the ISSUE 16 residency registry would satisfy same-executor
+        # reads before the ladder (and shift the poll cadence the death
+        # seed was scanned for); test_fuzz_exchange_chaos owns the
+        # exchange-on chaos story
+        "ballista.tpu.exchange": "false",
         "ballista.chaos.rate": "0.05",
         "ballista.chaos.seed": str(170 + seed),
         "ballista.chaos.sites": "shuffle.store",
@@ -786,3 +792,93 @@ def test_fuzz_shared_tier_chaos(seed, tmp_path):
     assert stats.get("chaos_executor_death", 0) >= 1, stats
     assert tier.get("storage_publish", 0) > 0, tier
     assert tier.get("storage_fetch", 0) > 0, tier
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_exchange_chaos(seed):
+    """HBM-resident exchange fuzz slice (ISSUE 16 satellite): random
+    2-stage plans run fault-free with the exchange OFF (pure authoritative
+    piece ladder — the oracle), then with the exchange ON under seeded
+    exchange.evict chaos (consume-time registry probes torn) PLUS a
+    deterministic mid-run executor death (the registry dies with its
+    executor). The residency tier is pure acceleration: every loss
+    degrades to the ladder, so results must be bit-identical. Own rng
+    streams (26000+ data, 27000+ queries), so every baseline stream above
+    stays byte-identical."""
+    import ballista_tpu.scheduler.state as state_mod
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.ops import exchange
+    from ballista_tpu.ops.runtime import exchange_stats, recovery_stats
+    from ballista_tpu.utils.chaos import ChaosInjector
+
+    rng = np.random.default_rng(26000 + seed)
+    qrng = np.random.default_rng(27000 + seed)
+    _fresh()
+    n = int(rng.integers(2_000, 8_000))
+    table = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+            "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+            "s": pa.array([f"t{x}" for x in rng.integers(0, 5, n)]),
+        }
+    )
+    queries = _distributed_fuzz_queries(qrng)
+
+    clean = _run_distributed(
+        table, queries,
+        {"ballista.shuffle.partitions": "4",
+         "ballista.tpu.exchange": "false"},
+    )
+
+    # deterministic executor death: local-0 dies within its first polls,
+    # local-1 survives the whole run (pure hashing, stable forever)
+    death_seed = None
+    for cand in range(2000):
+        inj = ChaosInjector(cand, 0.005, sites={"executor.death"})
+
+        def death_poll(eid, horizon):
+            for k in range(1, horizon):
+                if inj.should_inject("executor.death", f"{eid}/poll{k}"):
+                    return k
+            return None
+
+        d0 = death_poll("local-0", 17)
+        if d0 is not None and 4 <= d0 and death_poll("local-1", 400) is None:
+            death_seed = cand
+            break
+    assert death_seed is not None, "no death seed in scan range"
+
+    chaos_client = {
+        "ballista.shuffle.partitions": "4",
+        "ballista.chaos.rate": "0.3",
+        "ballista.chaos.seed": str(190 + seed),
+        "ballista.chaos.sites": "exchange.evict",
+        "ballista.shuffle.max_task_retries": "5",
+    }
+    chaos_cluster = BallistaConfig({
+        "ballista.chaos.rate": "0.005",
+        "ballista.chaos.seed": str(death_seed),
+        "ballista.chaos.sites": "executor.death",
+        "ballista.shuffle.max_task_retries": "5",
+    })
+    old_lease = state_mod.EXECUTOR_LEASE_SECS
+    state_mod.EXECUTOR_LEASE_SECS = 1.0
+    exchange.reset()
+    exchange_stats(reset=True)
+    recovery_stats(reset=True)
+    try:
+        chaotic = _run_distributed(table, queries, chaos_client, chaos_cluster)
+    finally:
+        state_mod.EXECUTOR_LEASE_SECS = old_lease
+    stats = recovery_stats(reset=True)
+    ex = exchange_stats(reset=True)
+    for sql, c, t in zip(queries, clean, chaotic):
+        assert t.equals(c), (sql, t.to_pydict(), c.to_pydict())
+    assert stats.get("chaos_injected", 0) > 0, stats
+    assert stats.get("chaos_executor_death", 0) >= 1, stats
+    # the registry was exercised AND torn: publishes happened, at least
+    # one probe lost its entry to chaos, and the reads that missed walked
+    # the ladder instead of failing the task
+    assert ex.get("published", 0) > 0, ex
+    assert ex.get("evicted_chaos", 0) >= 1, ex

@@ -312,3 +312,43 @@ def test_refactorize_preserves_null_sentinel():
                  if v is None]
     assert len(null_rows) == 1
     assert out.column("rv")[null_rows[0]].as_py() is None
+
+
+def test_admission_declines_mesh_join_when_model_prefers_host(tmp_path):
+    """Join admission rides the cost model (ISSUE 16 satellite): with the
+    mesh exchange rate warm-and-slow and the inline host join warm-and-
+    fast, execute() joins inline over the already-collected sides before
+    ever compiling the mesh program — last_path == "host-inline", a
+    recorded host_declined decision, oracle-identical rows."""
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.ops.runtime import join_path_stats
+
+    dim, fact = _dim(), _fact()
+    settings = {
+        **SPMD_SETTINGS,
+        "ballista.tpu.cost_model": "true",
+        "ballista.tpu.cost_model_dir": str(tmp_path / "costs"),
+    }
+    spmd, cfg = _plan_join(dim, fact, ["dk"], ["fk"], "inner",
+                           settings=settings)
+    assert spmd is not None, "planner did not fuse the join"
+    costmodel.reset(clear_dir=True)
+    costmodel.configure(cfg)
+    try:
+        # predict falls back to the op-global rate for the unseen
+        # mesh_units bucket, so one slow seed covers every join shape
+        costmodel.seed("join.mesh", 1000.0, 1e6)
+        costmodel.seed("join.host", 1000.0, 1e-6, engine="host")
+        join_path_stats(reset=True)
+        tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+        out = pa.Table.from_batches(list(spmd.execute(0, tctx)))
+        assert spmd.last_path == "host-inline"
+        stats = join_path_stats(reset=True)
+        assert stats["paths"].get("host_declined") == 1
+        assert any("cost model" in r for r in stats["reasons"])
+
+        oracle = _host_oracle(dim, fact, ["dk"], ["fk"], "inner")
+        assert out.num_rows == oracle.num_rows
+        assert _canon(out, ["dk", "amount"]) == _canon(oracle, ["dk", "amount"])
+    finally:
+        costmodel.reset(clear_dir=True)

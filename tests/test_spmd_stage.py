@@ -466,3 +466,114 @@ def test_distributed_spmd_end_to_end(sales_table):
         c.close()
     finally:
         cluster.shutdown()
+
+
+def test_admission_declines_mesh_when_model_prefers_host(tmp_path):
+    """Mesh admission rides the cost model (ISSUE 16 satellite): with BOTH
+    the mesh and host rates warm for this stage shape and the mesh
+    predicted slower, execute() routes to the host subplan up front (no
+    mesh launch) — last_path == "host", identical rows. Re-seeding the
+    model mesh-cheap flips the same node back to the mesh."""
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.physical.plan import TaskContext
+    from ballista_tpu.utils import tracing
+
+    table = _sales()
+    settings = {
+        **SPMD_SETTINGS,
+        "ballista.tpu.cost_model": "true",
+        "ballista.tpu.cost_model_dir": str(tmp_path / "costs"),
+    }
+    cfg = BallistaConfig(settings)
+    ctx, phys = _physical(table, settings)
+    stages = DistributedPlanner(cfg).plan_query_stages("job", phys)
+
+    def find(n):
+        if isinstance(n, SpmdAggregateExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    spmd = next(s for s in (find(st) for st in stages) if s is not None)
+    fp = spmd.fingerprint()[:12]
+    costmodel.reset(clear_dir=True)
+    costmodel.configure(cfg)
+    try:
+        costmodel.seed("mesh.agg|" + fp, 1.0, 10.0)
+        costmodel.seed("mesh.agg.host|" + fp, 1.0, 1e-4, engine="host")
+        declined_before = tracing.counters().get("spmd.host_declined", 0)
+        tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+        host_out = pa.Table.from_batches(
+            list(spmd.execute(0, tctx))
+        ).sort_by("region")
+        assert spmd.last_path == "host"
+        assert (
+            tracing.counters().get("spmd.host_declined", 0)
+            == declined_before + 1
+        )
+
+        # inverse seeding (seed replaces the bucket history) re-admits the
+        # mesh on the very next execute — and the rows cannot move
+        costmodel.seed("mesh.agg|" + fp, 1.0, 1e-6)
+        costmodel.seed("mesh.agg.host|" + fp, 1.0, 10.0, engine="host")
+        mesh_out = pa.Table.from_batches(
+            list(spmd.execute(0, tctx))
+        ).sort_by("region")
+        assert spmd.last_path == "mesh"
+        # summation ORDER differs between paths: exact on every column but
+        # the float sum, which gets the same tolerance the mesh-vs-host
+        # equivalence test uses
+        for name in ("region", "c", "sq"):
+            assert (mesh_out.column(name).to_pylist()
+                    == host_out.column(name).to_pylist())
+        np.testing.assert_allclose(
+            mesh_out.column("s").to_numpy(), host_out.column("s").to_numpy(),
+            rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            mesh_out.column("mn").to_numpy(), host_out.column("mn").to_numpy(),
+            rtol=1e-6, atol=1e-6,
+        )
+    finally:
+        costmodel.reset(clear_dir=True)
+
+
+def test_admission_stays_mesh_while_host_rate_is_cold(tmp_path):
+    """A warm mesh rate alone must NOT decline: the gate needs both sides
+    warm, so the cold-start behavior is exactly the pre-model ladder."""
+    from ballista_tpu.ops import costmodel
+    from ballista_tpu.physical.plan import TaskContext
+
+    table = _sales()
+    settings = {
+        **SPMD_SETTINGS,
+        "ballista.tpu.cost_model": "true",
+        "ballista.tpu.cost_model_dir": str(tmp_path / "costs"),
+    }
+    cfg = BallistaConfig(settings)
+    ctx, phys = _physical(table, settings)
+    stages = DistributedPlanner(cfg).plan_query_stages("job", phys)
+
+    def find(n):
+        if isinstance(n, SpmdAggregateExec):
+            return n
+        for c in n.children():
+            r = find(c)
+            if r is not None:
+                return r
+        return None
+
+    spmd = next(s for s in (find(st) for st in stages) if s is not None)
+    costmodel.reset(clear_dir=True)
+    costmodel.configure(cfg)
+    try:
+        # arbitrarily slow mesh, but no host observation → admit
+        costmodel.seed("mesh.agg|" + spmd.fingerprint()[:12], 1.0, 1e9)
+        tctx = TaskContext(config=cfg, work_dir="/tmp", job_id="t")
+        list(spmd.execute(0, tctx))
+        assert spmd.last_path == "mesh"
+    finally:
+        costmodel.reset(clear_dir=True)

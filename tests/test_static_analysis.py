@@ -276,6 +276,25 @@ def test_failure_rule_fleet_site_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_exchange_site_fixture_pair():
+    """ISSUE 16: the exchange.evict site is registered — an unregistered
+    exchange site and a computed exchange site name fail lint; the
+    registered-literal shape (plan-coordinate + consuming-attempt key on
+    the residency probe) is clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_exchange_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "exchange.drop" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_exchange_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_routing_rule_fixture_pair():
     """ISSUE 10 satellite: a decline-helper call with no routing
     observation in scope and no cold-path annotation fails lint — a
