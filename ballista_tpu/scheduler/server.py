@@ -60,11 +60,13 @@ class _PushSubscriber:
     shared with the stream generator thread."""
 
     def __init__(self, executor_id: str, slots: int) -> None:
-        self.executor_id = executor_id
-        self.slots = max(1, slots)
-        self.queue: "queue.Queue[pb.TaskDefinition]" = queue.Queue()
-        self.closed = threading.Event()
-        self.outstanding: set = set()  # (job, stage, part, attempt)
+        self.executor_id = executor_id  # durability: ephemeral(stream identity, dies with the stream)
+        self.slots = max(1, slots)  # durability: ephemeral(credit capacity of this live stream)
+        self.queue: "queue.Queue[pb.TaskDefinition]" = queue.Queue()  # durability: ephemeral(live stream plumbing)
+        self.closed = threading.Event()  # durability: ephemeral(live stream plumbing)
+        # (job, stage, part, attempt)
+        # durability: ephemeral(credit ledger, re-verified against the KV on every pump)
+        self.outstanding: set = set()
 
     def close(self) -> None:
         """Close + UNBLOCK: the None sentinel wakes a stream generator
@@ -83,25 +85,25 @@ class SchedulerServer:
         config: Optional[BallistaConfig] = None,
         synchronous_planning: bool = False,
     ) -> None:
-        self.config = config or BallistaConfig()
+        self.config = config or BallistaConfig()  # durability: ephemeral(construction parameter)
         # ISSUE 14: one config flag arms the dynamic lock-order witness for
         # the whole process (scheduler threads, stream generators, pumps)
         from ballista_tpu.utils import locks as _locks
 
         _locks.maybe_enable_from_config(self.config)
-        self.state = SchedulerState(kv or MemoryBackend(), namespace, config=self.config)
+        self.state = SchedulerState(kv or MemoryBackend(), namespace, config=self.config)  # durability: ephemeral(the owned SchedulerState, classified field by field)
         # restart recovery BEFORE serving: discard torn (uncommitted) jobs,
         # reload the durable assignment ledger with a fresh grace window
         # (no-op with zero counters on a fresh store)
-        self.recovery_stats = self.state.recover()
+        self.recovery_stats = self.state.recover()  # durability: ephemeral(snapshot of this life's recovery counters)
         # catalog for SQL queries arriving as text (CREATE EXTERNAL TABLE
         # statements executed through the scheduler register here)
-        self.catalog = ExecutionContext(self.config)
-        self.synchronous_planning = synchronous_planning
+        self.catalog = ExecutionContext(self.config)  # durability: ephemeral(clients re-register external tables per session)
+        self.synchronous_planning = synchronous_planning  # durability: ephemeral(construction parameter)
         # dead-executor sweep clock, touched only inside PollWork's global
         # lock (the `self._lock = threading.Lock()` that used to sit here
         # guarded nothing — the ISSUE 14 coverage sweep retired it)
-        self._last_lost_check = 0.0  # guarded-by: self.state.kv.lock()
+        self._last_lost_check = 0.0  # durability: ephemeral(sweep clock, a fresh replica sweeps promptly)  # guarded-by: self.state.kv.lock()
         # deterministic scheduler-death injection (utils/chaos.py
         # "scheduler.crash"): keyed on the ACCEPTED-STATUS sequence so the
         # seeded crash lands mid-job (statuses only exist after planning
@@ -109,17 +111,17 @@ class SchedulerServer:
         # RPC answers UNAVAILABLE — exactly what a dead process looks like
         # to retrying clients — until the harness restarts the scheduler on
         # the same KV store (StandaloneCluster.restart_scheduler).
-        self._chaos = self.state._chaos
-        self._accepted_statuses = 0  # under the kv lock (PollWork body)
-        self.crashed = False
-        self.on_crash = None
+        self._chaos = self.state._chaos  # durability: ephemeral(deterministic fault-injection config, per process by design)
+        self._accepted_statuses = 0  # under the kv lock (PollWork body)  # durability: ephemeral(per-process chaos sequence)
+        self.crashed = False  # durability: ephemeral(crash-simulation flag for this process only)
+        self.on_crash = None  # durability: ephemeral(harness callback)
         # tasks running on executors whose lease lapsed are rescheduled this
         # often (the reference loses such work permanently)
-        self.lost_task_check_interval = 5.0
+        self.lost_task_check_interval = 5.0  # durability: ephemeral(tuning knob)
         # GetFileMetadata walks globs and reads parquet footers; cap how many
         # RPC worker threads it may hold at once so a burst of large metadata
         # requests can never starve PollWork heartbeats of workers
-        self._file_meta_slots = threading.BoundedSemaphore(4)
+        self._file_meta_slots = threading.BoundedSemaphore(4)  # durability: ephemeral(RPC worker throttle, process-local by nature)
         # cross-job physical-plan cache (ISSUE 7): optimize + physical
         # planning output serialized per CONTENT key (plan proto + settings,
         # no mtimes — planning depends on the file LIST, not file contents),
@@ -127,18 +129,18 @@ class SchedulerServer:
         # cached value is the serialized proto, deserialized fresh per job:
         # plan trees are mutable (stage split, operator state) and must
         # never be shared across planner invocations.
-        self._plan_cache_mu = make_lock("scheduler.server._plan_cache_mu")
-        self._plan_cache: "dict[str, bytes]" = {}  # guarded-by: self._plan_cache_mu
-        self._plan_cache_cap = 128
+        self._plan_cache_mu = make_lock("scheduler.server._plan_cache_mu")  # durability: ephemeral(a lock guards state, it is not state)
+        self._plan_cache: "dict[str, bytes]" = {}  # durability: ephemeral(content-keyed memo, a fresh replica misses once per plan)  # guarded-by: self._plan_cache_mu
+        self._plan_cache_cap = 128  # durability: ephemeral(tuning knob)
         # push-based task dispatch (ISSUE 8): executor id -> open stream.
         # The registry lock only guards the dict itself; subscriber credit
         # state is touched under the global KV lock (see _PushSubscriber).
         # Ordering: kv.lock() may be held when _push_mu is taken (pump),
         # NEVER the reverse.
-        self.push_enabled = self.config.push_dispatch()
-        self._push_mu = make_lock("scheduler.server._push_mu")
-        self._subscribers: Dict[str, _PushSubscriber] = {}  # guarded-by: self._push_mu
-        self._push_seq = 0  # scheduler.push chaos rotation; under the kv lock
+        self.push_enabled = self.config.push_dispatch()  # durability: ephemeral(config snapshot)
+        self._push_mu = make_lock("scheduler.server._push_mu")  # durability: ephemeral(a lock guards state, it is not state)
+        self._subscribers: Dict[str, _PushSubscriber] = {}  # durability: ephemeral(live stream registry, streams die with the process)  # guarded-by: self._push_mu
+        self._push_seq = 0  # scheduler.push chaos rotation; under the kv lock  # durability: ephemeral(per-process chaos sequence)
         # push job-status notifications (ISSUE 11): job id -> queues of
         # open SubscribeJobStatus streams. The state hook fans every
         # job-status write out to them; each stream terminates itself after
@@ -146,12 +148,12 @@ class SchedulerServer:
         # short-lived. Queue puts are internally thread-safe; the dict is
         # guarded by its own lock (never taken with the KV lock held by
         # anything that blocks).
-        self._status_mu = make_lock("scheduler.server._status_mu")
-        self._status_subs: Dict[str, list] = {}  # guarded-by: self._status_mu
+        self._status_mu = make_lock("scheduler.server._status_mu")  # durability: ephemeral(a lock guards state, it is not state)
+        self._status_subs: Dict[str, list] = {}  # durability: ephemeral(live stream registry, streams die with the process)  # guarded-by: self._status_mu
         # job -> last pushed serialized status: synchronize_job_status
         # re-writes a byte-identical running status on every non-final
         # task completion; one push per TRANSITION means suppressing those
-        self._status_last: Dict[str, bytes] = {}  # guarded-by: self._status_mu
+        self._status_last: Dict[str, bytes] = {}  # durability: ephemeral(push dedup memo, a reconnected stream gets a fresh snapshot)  # guarded-by: self._status_mu
         self.state.on_job_status = self._notify_job_status
 
     # -- crash simulation ---------------------------------------------------

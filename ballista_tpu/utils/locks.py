@@ -278,13 +278,24 @@ def reset_witness() -> None:
         _violations.clear()
 
 
+def _env_dump_path() -> Optional[str]:
+    """Per-process dump path for env-armed runs. Witness CI lanes fork
+    worker processes that ALL inherit BALLISTA_LOCK_WITNESS_OUT; with one
+    shared path the last atexit os.replace wins and every other process's
+    edges vanish. Each process dumps to <OUT>.<pid> instead, and
+    `--check-witness` accepts the whole set, merging edges before the
+    static diff."""
+    out = os.environ.get("BALLISTA_LOCK_WITNESS_OUT")
+    return f"{out}.{os.getpid()}" if out else None
+
+
 def maybe_enable_from_config(config) -> None:
     """Arm the witness when ballista.debug.lock_witness is set — called by
     the scheduler/executor entry points so one config flag covers a whole
     StandaloneCluster. Enabling is sticky and process-global."""
     try:
         if config.debug_lock_witness():
-            enable_witness(os.environ.get("BALLISTA_LOCK_WITNESS_OUT") or None)
+            enable_witness(_env_dump_path())
     except Exception:
         pass
 
@@ -322,4 +333,4 @@ def dump(path: str) -> dict:
 # env arming at import: one variable turns every subsequently created (and
 # existing — the flag is checked per acquire) project lock into a witness
 if os.environ.get("BALLISTA_LOCK_WITNESS", "").strip() in ("1", "true", "yes"):
-    enable_witness(os.environ.get("BALLISTA_LOCK_WITNESS_OUT") or None)
+    enable_witness(_env_dump_path())

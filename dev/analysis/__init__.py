@@ -29,6 +29,22 @@ cannot see; this package turns them into machine-checked gates
   an `except UnsupportedOnDevice` handler must not silently `return None`,
   and ad-hoc `Exception`/`RuntimeError`/`NotImplementedError` raises are
   not decline channels.
+- **routing-discipline** / **failure-discipline** (`rules_routing.py`,
+  `rules_failure.py`) — tier-routing and retry/requeue conventions; see
+  their module docstrings.
+- **lock-order** (`rules_lockorder.py` + `lockgraph.py` +
+  `lockorder.toml`) — whole-program acquired-while-held graph, deadlock
+  cycles, manifest-declared ordering, the check-then-act atomicity
+  sub-check, and the `--check-witness` runtime cross-check (repeatable:
+  per-process `<OUT>.<pid>` dumps from forked CI workers are merged).
+- **durability** (`rules_durability.py` + `durability.toml`) — every
+  attribute on SchedulerState/SchedulerServer/_PushSubscriber must carry
+  `# durability: durable(<kv-prefix>) | derived(<rebuild-fn>) |
+  ephemeral(<reason>)` agreeing with the reviewed manifest; durable
+  mutations must pair with a same-scope KV op against the declared
+  prefix, derived rebuilds must be reachable from `recover()`, ephemeral
+  counts are budgeted per class, and `save_task_status` callers must
+  consult the attempt/ledger guard (or carry `# attempt-guard-ok:`).
 
 Suppression syntax (a reason is mandatory, checked by the always-on
 `lint-usage` meta rule):

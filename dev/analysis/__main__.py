@@ -15,30 +15,49 @@ from dev.analysis.core import RULE_NAMES, run_paths
 SUPPRESSION_BUDGET = 5  # package-wide cap (ISSUE 3 acceptance criteria)
 
 
-def check_witness(witness_path: str, paths, as_json: bool = False,
+def check_witness(witness_paths, paths, as_json: bool = False,
                   use_cache: bool = True, cache_path=None) -> int:
     """--check-witness: runtime-vs-static lock-order cross-check (ISSUE 14).
 
-    Exit 1 when the witness recorded edges the static analyzer never
-    derived (analyzer bugs / missing may-acquire annotations) or recorded
-    order violations; stale declared edges only warn."""
+    Accepts the flag repeatedly (ISSUE 18 satellite): witness CI lanes
+    fork worker processes that each dump their own <OUT>.<pid> record, and
+    the edge sets are MERGED (union of edges with summed counts, violations
+    concatenated) before the diff — an edge witnessed in any process
+    counts, a declared edge is stale only if NO process saw it.
+
+    Exit 1 when the merged witness recorded edges the static analyzer
+    never derived (analyzer bugs / missing may-acquire annotations) or
+    recorded order violations; stale declared edges only warn."""
     from dev.analysis.lockgraph import Manifest, diff_witness, load_witness
     from dev.analysis.rules_lockorder import static_edges
 
-    try:
-        witness = load_witness(witness_path)
-    except (OSError, ValueError) as e:
-        print(f"error: cannot read witness {witness_path}: {e}",
-              file=sys.stderr)
-        return 2
+    witness = {"edges": [], "violations": []}
+    seen = {}
+    for wp in witness_paths:
+        try:
+            rec = load_witness(wp)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read witness {wp}: {e}", file=sys.stderr)
+            return 2
+        for edge in rec.get("edges", ()):
+            key = (edge.get("src"), edge.get("dst"))
+            if key in seen:
+                seen[key]["count"] = seen[key].get("count", 1) \
+                    + edge.get("count", 1)
+            else:
+                seen[key] = dict(edge)
+                witness["edges"].append(seen[key])
+        witness["violations"].extend(rec.get("violations", ()))
     edges = static_edges(paths, use_cache=use_cache, cache_path=cache_path)
     report = diff_witness(witness, edges, Manifest.load())
     report["static_edges"] = len(edges)
+    report["witness_files"] = len(witness_paths)
     report["ok"] = not report["missed"] and not report["violations"]
     if as_json:
         print(json.dumps(report, indent=2))
     else:
-        print(f"witness: {report['runtime_edges']} runtime edge(s), "
+        print(f"witness: {report['runtime_edges']} runtime edge(s) from "
+              f"{report['witness_files']} dump(s), "
               f"{report['static_edges']} static edge(s)")
         for s, d in report["missed"]:
             print(f"MISSED statically: {s} -> {d} (analyzer bug or missing "
@@ -75,11 +94,14 @@ def main(argv=None) -> int:
                          "cache semantics, deterministic report order; "
                          "0 = one per CPU)")
     ap.add_argument("--check-witness", metavar="WITNESS_JSON", default=None,
+                    action="append",
                     help="diff a runtime lock-witness dump "
                          "(ballista.debug.lock_witness) against the static "
                          "lock-order graph: runtime edges the analyzer "
                          "missed fail; declared-but-never-witnessed edges "
-                         "are flagged stale")
+                         "are flagged stale. Repeatable: multi-process "
+                         "lanes dump one <OUT>.<pid> file each, and the "
+                         "edge sets merge before the diff")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -89,7 +111,7 @@ def main(argv=None) -> int:
     paths = args.paths or ["ballista_tpu"]
     jobs = args.jobs if args.jobs > 0 else (os.cpu_count() or 1)
 
-    if args.check_witness is not None:
+    if args.check_witness:
         return check_witness(args.check_witness, paths, as_json=args.as_json,
                              use_cache=not args.no_cache,
                              cache_path=args.cache_file)
@@ -123,6 +145,12 @@ def main(argv=None) -> int:
             f"({stats['cache_hits']} cached), {len(findings)} finding(s), "
             f"{stats['suppressions']} suppression(s)"
         )
+        # per-rule cost/yield (ISSUE 18 satellite): only rules that found
+        # something are worth a line; clean runs keep the one-line summary
+        for rule, rec in stats.get("rules", {}).items():
+            if rec["findings"]:
+                print(f"  {rule}: {rec['findings']} finding(s), "
+                      f"{rec['wall_s']:.3f}s")
         if over_budget:
             print(
                 f"ballista-lint: suppression budget exceeded "

@@ -68,6 +68,7 @@ def _load_rules() -> None:
     from dev.analysis import (  # noqa: F401
         rules_decline,
         rules_dtype,
+        rules_durability,
         rules_failure,
         rules_guarded,
         rules_lockorder,
@@ -103,6 +104,14 @@ _ATOMICITY_OK_RE = re.compile(r"#\s*atomicity-ok:\s*(\S[^#]*?)\s*$")
 # may acquire the named canonical locks even though no call edge resolves
 # to them statically — feeds the lock-order graph (ISSUE 14)
 _MAY_ACQUIRE_RE = re.compile(r"#\s*may-acquire:\s*(\S[^#]*?)\s*$")
+# replica-coherence classification of scheduler state (ISSUE 18):
+# durable(<kv-prefix>) | derived(<rebuild-fn>) | ephemeral(<reason>)
+_DURABILITY_RE = re.compile(
+    r"#\s*durability:\s*(durable|derived|ephemeral)\(([^()]*)\)"
+)
+# a function folding a TaskStatus into durable state without the attempt/
+# ledger guard, reviewed and accepted (ISSUE 18)
+_ATTEMPT_OK_RE = re.compile(r"#\s*attempt-guard-ok:\s*(\S[^#]*?)\s*$")
 
 
 @dataclasses.dataclass
@@ -131,6 +140,8 @@ class SourceFile:
         self.holds: Dict[int, str] = {}  # line -> lock expr
         self.atomicity_ok: Dict[int, str] = {}  # line -> reason
         self.may_acquire: Dict[int, str] = {}  # line -> lock list expr
+        self.durability: Dict[int, Tuple[str, str]] = {}  # line -> (class, arg)
+        self.attempt_ok: Dict[int, str] = {}  # line -> reason
         self.meta_findings: List[Finding] = []
         self.path = display_path
         self._scan_comments()
@@ -163,6 +174,15 @@ class SourceFile:
             ma = _MAY_ACQUIRE_RE.search(text)
             if ma:
                 self.may_acquire[line] = ma.group(1).strip()
+            du = _DURABILITY_RE.search(text)
+            if du:
+                # a standalone annotation covers the next line's statement
+                self.durability[line if not standalone else line + 1] = (
+                    du.group(1), du.group(2).strip()
+                )
+            ao = _ATTEMPT_OK_RE.search(text)
+            if ao:
+                self.attempt_ok[line] = ao.group(1).strip()
             m = _DIRECTIVE_RE.search(text)
             if not m:
                 continue
@@ -216,6 +236,10 @@ class SourceFile:
         """Lock list named by a `# may-acquire:` comment on the def."""
         return self._def_annotation(func, self.may_acquire)
 
+    def attempt_ok_of(self, func: ast.AST) -> Optional[str]:
+        """Reason named by an `# attempt-guard-ok:` comment on the def."""
+        return self._def_annotation(func, self.attempt_ok)
+
     def _def_annotation(self, func: ast.AST, table: Dict[int, str]) -> Optional[str]:
         if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
             return None
@@ -261,11 +285,14 @@ def _display_path(path: str) -> str:
     return os.path.relpath(ap, root) if ap.startswith(root + os.sep) else path
 
 
-def _analyze(path: str) -> Tuple[List[Finding], int, dict]:
-    """(surviving findings, reasoned-suppression count, facts) for one
-    file — one read/parse/tokenize pass serves all three. Facts feed the
-    whole-program passes (lock-order graph) and are cached beside the
-    findings."""
+def _analyze(path: str) -> Tuple[List[Finding], int, dict, Dict[str, float]]:
+    """(surviving findings, reasoned-suppression count, facts, per-rule
+    wall seconds) for one file — one read/parse/tokenize pass serves all
+    four. Facts feed the whole-program passes (lock-order graph, durability
+    coverage) and are cached beside the findings; timings are never cached
+    (they describe THIS run's work, ISSUE 18 satellite)."""
+    import time as _time
+
     _load_rules()
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
@@ -273,23 +300,43 @@ def _analyze(path: str) -> Tuple[List[Finding], int, dict]:
         sf = SourceFile(path, source, _display_path(path))
     except SyntaxError as e:
         return [Finding(META_RULE, _display_path(path), e.lineno or 1, 0,
-                        f"syntax error: {e.msg}")], 0, {}
+                        f"syntax error: {e.msg}")], 0, {}, {}
     findings: List[Finding] = []
+    timings: Dict[str, float] = {}
     for name, check in sorted(_REGISTRY.items()):
+        t0 = _time.perf_counter()
         findings.extend(check(sf))
+        timings[name] = timings.get(name, 0.0) + (_time.perf_counter() - t0)
     findings = sf.apply_suppressions(findings)
     findings.extend(sf.meta_findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    facts = {name: fn(sf) for name, fn in sorted(_FACTS.items())}
-    return findings, len(sf.suppressions), facts
+    facts = {}
+    for name, fn in sorted(_FACTS.items()):
+        t0 = _time.perf_counter()
+        facts[name] = fn(sf)
+        # fact extraction bills to its rule: the cost is real either way
+        timings[name] = timings.get(name, 0.0) + (_time.perf_counter() - t0)
+    return findings, len(sf.suppressions), facts, timings
 
 
-def _global_findings(facts_by_path: Dict[str, dict]) -> List[Finding]:
-    """Run every whole-program pass over the collected per-file facts."""
+def _global_findings(
+    facts_by_path: Dict[str, dict],
+    timings: Optional[Dict[str, float]] = None,
+) -> List[Finding]:
+    """Run every whole-program pass over the collected per-file facts.
+    When `timings` is given, each pass's wall seconds accumulate into it
+    under the pass's rule name."""
+    import time as _time
+
     _load_rules()
     findings: List[Finding] = []
     for name, fn in sorted(_GLOBAL.items()):
+        t0 = _time.perf_counter()
         findings.extend(fn(facts_by_path))
+        if timings is not None:
+            timings[name] = timings.get(name, 0.0) + (
+                _time.perf_counter() - t0
+            )
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -299,7 +346,7 @@ def analyze_file(path: str) -> List[Finding]:
     including the whole-program passes scoped to just this file, so a
     single-file CLI run (and the fixture pair tests) exercise the
     lock-order graph checks."""
-    findings, _n, facts = _analyze(path)
+    findings, _n, facts, _t = _analyze(path)
     findings = findings + _global_findings({_display_path(path): facts})
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
@@ -316,7 +363,7 @@ CACHE_BASENAME = ".ballista_lint_cache.json"
 
 
 def _analyzer_hash() -> str:
-    """Hash of the analyzer's own sources AND the lock-order manifest: a
+    """Hash of the analyzer's own sources AND the in-tree manifests: a
     rule or manifest change invalidates every cached verdict."""
     d = os.path.dirname(os.path.abspath(__file__))
     h = hashlib.sha1()
@@ -328,6 +375,34 @@ def _analyzer_hash() -> str:
     return h.hexdigest()[:16]
 
 
+def durability_manifest_path() -> str:
+    """dev/analysis/durability.toml, overridable via
+    BALLISTA_DURABILITY_MANIFEST (tests point it at scratch manifests)."""
+    return os.environ.get("BALLISTA_DURABILITY_MANIFEST") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "durability.toml"
+    )
+
+
+def _manifest_hash() -> str:
+    """Hash of the manifests as RESOLVED right now (env overrides
+    included). Folded into every per-file cache key: per-file findings
+    depend on the manifests (durability agreement, ISSUE 18), and the
+    blob-level analyzer hash only covers the in-tree copies — an
+    env-overridden manifest edit used to leave stale per-file verdicts
+    until an analyzer-hash bump."""
+    from dev.analysis.lockgraph import default_manifest_path
+
+    h = hashlib.sha1()
+    for path in (default_manifest_path(), durability_manifest_path()):
+        h.update(path.encode())
+        try:
+            with open(path, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<absent>")
+    return h.hexdigest()[:12]
+
+
 class FileCache:
     def __init__(self, cache_path: Optional[str]):
         self.cache_path = cache_path
@@ -335,6 +410,7 @@ class FileCache:
         self.dirty = False
         self.hits = 0
         self._ahash = _analyzer_hash()
+        self._mhash = _manifest_hash()
         if cache_path and os.path.exists(cache_path):
             try:
                 with open(cache_path) as f:
@@ -346,7 +422,7 @@ class FileCache:
 
     def _key(self, path: str) -> str:
         st = os.stat(path)
-        return f"{st.st_mtime_ns}:{st.st_size}"
+        return f"{st.st_mtime_ns}:{st.st_size}:{self._mhash}"
 
     def get(self, path: str) -> Optional[Tuple[List[Finding], int, dict]]:
         ap = os.path.abspath(path)
@@ -402,11 +478,13 @@ def collect_py_files(paths: List[str]) -> List[str]:
     return out
 
 
-def _analyze_for_pool(path: str) -> Tuple[str, List[dict], int, dict]:
+def _analyze_for_pool(
+    path: str,
+) -> Tuple[str, List[dict], int, dict, Dict[str, float]]:
     """Process-pool worker: one file, serialized findings (dicts pickle
     smaller and version-stably across pool boundaries)."""
-    findings, n_supp, facts = _analyze(path)
-    return path, [f.to_dict() for f in findings], n_supp, facts
+    findings, n_supp, facts, timings = _analyze(path)
+    return path, [f.to_dict() for f in findings], n_supp, facts, timings
 
 
 def run_paths(paths: List[str], use_cache: bool = True,
@@ -428,6 +506,7 @@ def run_paths(paths: List[str], use_cache: bool = True,
         cache_path = os.path.join(_repo_root(), CACHE_BASENAME)
     cache = FileCache(cache_path if use_cache else None)
     per_file: Dict[str, Tuple[List[Finding], int, dict]] = {}
+    rule_wall: Dict[str, float] = {}
     fresh = []
     for path in files:
         cached = cache.get(path) if use_cache else None
@@ -439,13 +518,18 @@ def run_paths(paths: List[str], use_cache: bool = True,
         import concurrent.futures
 
         with concurrent.futures.ProcessPoolExecutor(max_workers=jobs) as ex:
-            for path, fdicts, n_supp, facts in ex.map(
+            for path, fdicts, n_supp, facts, timings in ex.map(
                 _analyze_for_pool, fresh, chunksize=4
             ):
                 per_file[path] = ([Finding(**d) for d in fdicts], n_supp, facts)
+                for rule, secs in timings.items():
+                    rule_wall[rule] = rule_wall.get(rule, 0.0) + secs
     else:
         for path in fresh:
-            per_file[path] = _analyze(path)
+            findings_f, n_supp, facts, timings = _analyze(path)
+            per_file[path] = (findings_f, n_supp, facts)
+            for rule, secs in timings.items():
+                rule_wall[rule] = rule_wall.get(rule, 0.0) + secs
     findings: List[Finding] = []
     n_suppressions = 0
     facts_by_path: Dict[str, dict] = {}
@@ -458,12 +542,23 @@ def run_paths(paths: List[str], use_cache: bool = True,
         n_suppressions += n_supp
         facts_by_path[_display_path(path)] = facts
     cache.save()
-    findings.extend(_global_findings(facts_by_path))
+    findings.extend(_global_findings(facts_by_path, timings=rule_wall))
+    # per-rule finding counts + wall seconds (ISSUE 18 satellite): CI logs
+    # make a rule whose cost regresses visible. Wall covers FRESH analyses
+    # + the global passes; cached files cost (and bill) nothing.
+    by_rule: Dict[str, dict] = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, {"findings": 0, "wall_s": 0.0})
+        by_rule[f.rule]["findings"] += 1
+    for rule, secs in rule_wall.items():
+        by_rule.setdefault(rule, {"findings": 0, "wall_s": 0.0})
+        by_rule[rule]["wall_s"] = round(secs, 4)
     stats = {
         "files": len(files),
         "cache_hits": cache.hits,
         "suppressions": n_suppressions,
         "findings": len(findings),
+        "rules": dict(sorted(by_rule.items())),
     }
     return findings, stats
 
@@ -483,7 +578,7 @@ def collect_facts(paths: List[str], use_cache: bool = True,
         if cached is not None:
             out[_display_path(path)] = cached[2]
         else:
-            findings, n_supp, facts = _analyze(path)
+            findings, n_supp, facts, _t = _analyze(path)
             if use_cache:
                 cache.put(path, findings, n_supp, facts)
             out[_display_path(path)] = facts

@@ -229,8 +229,11 @@ class Manifest:
 
 
 def default_manifest_path() -> str:
-    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        MANIFEST_BASENAME)
+    # overridable for tests (the override's hash still folds into every
+    # per-file cache key — core._manifest_hash resolves THIS function)
+    return os.environ.get("BALLISTA_LOCKORDER_MANIFEST") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), MANIFEST_BASENAME
+    )
 
 
 # -- witness cross-check ------------------------------------------------------

@@ -322,10 +322,16 @@ def _written_key(target: ast.AST) -> Optional[Tuple[str, str]]:
 
 
 def _atomicity_findings(sf: SourceFile, module: str,
-                        known: Set[str]) -> List[Finding]:
+                        known: Set[str],
+                        keys_override: Optional[Set[Tuple[str, str]]] = None,
+                        rule: str = RULE) -> List[Finding]:
     """Check-then-act across a release: block A reads guarded state into
     locals, the lock is released, block B (same function, same lock)
-    writes guarded state from those locals without re-reading it."""
+    writes guarded state from those locals without re-reading it.
+
+    `keys_override` swaps the guarded-by-derived state keys for an
+    explicit set — rules_durability reuses this sweep over the durable
+    attribute set (ISSUE 18), reporting under its own `rule`."""
     findings: List[Finding] = []
     for func, _cls in iter_functions(sf.tree):
         # with-blocks per lock, in source order, top-level walk of this
@@ -346,7 +352,8 @@ def _atomicity_findings(sf: SourceFile, module: str,
         for lock, withs in blocks.items():
             if len(withs) < 2:
                 continue
-            keys = _guarded_keys_for(sf, module, lock)
+            keys = keys_override if keys_override is not None \
+                else _guarded_keys_for(sf, module, lock)
             if not keys:
                 continue
             withs.sort(key=lambda w: w.lineno)
@@ -408,7 +415,7 @@ def _atomicity_findings(sf: SourceFile, module: str,
                         src_w = tainted[next(iter(stale))]
                         shown = key[1] if key[0] == "global" else f"self.{key[1]}"
                         findings.append(Finding(
-                            RULE, sf.path, node.lineno, node.col_offset,
+                            rule, sf.path, node.lineno, node.col_offset,
                             f"check-then-act across a release of '{lock}': "
                             f"'{shown}' is written from state read under an "
                             f"EARLIER `with` (line {src_w.lineno}) — the "

@@ -11,8 +11,10 @@ SF=${SF:-0.01}
 
 # strict static-analysis gate FIRST: the device-path invariants (readback
 # accounting, tracer hygiene, dtype narrowing, lock discipline, decline
-# ladder) are machine-checked before anything executes — a violation fails
-# the tier in seconds instead of surfacing as a wrong bench number later.
+# ladder) and the scheduler durability contract (KV write-through,
+# recover() coverage, replica-coherence classification — ISSUE 18) are
+# machine-checked before anything executes — a violation fails the tier
+# in seconds instead of surfacing as a wrong bench number later.
 # --jobs 8 (ISSUE 15 satellite, PR 14 residue): per-file analysis fans out
 # over a process pool — 5.2s -> 1.6s cold on a 24-core box — with output
 # and cache semantics identical to serial (pinned by
@@ -252,12 +254,18 @@ PY
 # declared order at acquisition time. Hard asserts: the test's own
 # bit-identity + zero-retry contract, ZERO order violations, and ZERO
 # runtime edges the static analyzer missed.
-rm -f /tmp/_ballista_witness_elastic.json
+rm -f /tmp/_ballista_witness_elastic.json.*
 JAX_PLATFORMS=cpu BALLISTA_LOCK_WITNESS=1 \
     BALLISTA_LOCK_WITNESS_OUT=/tmp/_ballista_witness_elastic.json \
     python -m pytest -q -p no:cacheprovider \
     "tests/test_elastic_shuffle.py::test_scale_in_during_running_job_bit_identical_zero_retries"
-python -m dev.analysis --check-witness /tmp/_ballista_witness_elastic.json ballista_tpu
+# env-armed dumps are per-process (<OUT>.<pid>, ISSUE 18 satellite): pass
+# every dump and the edge sets merge before the static diff
+WITNESS_ARGS=()
+for f in /tmp/_ballista_witness_elastic.json.*; do
+    WITNESS_ARGS+=(--check-witness "$f")
+done
+python -m dev.analysis "${WITNESS_ARGS[@]}" ballista_tpu
 
 # strict gate on the concurrency analyzer (ISSUE 14): lock-order graph
 # construction, cycle detection, manifest round-trip + enforcement
@@ -269,6 +277,17 @@ python -m dev.analysis --check-witness /tmp/_ballista_witness_elastic.json balli
 # in dev/analysis/lockorder.toml, suppressions within budget.)
 JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
     tests/test_lockorder.py
+
+# strict gate on the durability analyzer (ISSUE 18): replica-coherence
+# classification coverage (every SchedulerState/server attribute durable /
+# derived / ephemeral), durable-mutation KV write-through, derived-rebuild
+# reachability from recover(), attempt-guard discipline, ephemeral
+# budgets, manifest agreement — plus the randomized crash-recovery
+# property test (kill at a seeded accepted-status point, restart, every
+# analyzer-classified derived attribute rebuilds equal to the
+# never-crashed control).
+JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    tests/test_durability_analysis.py tests/test_durability_recovery.py
 
 # witness smoke (ISSUE 14): one seeded chaos e2e — executor death mid-run
 # plus a scheduler restart on the same store — under
@@ -405,9 +424,16 @@ PY
 # edge the static analyzer missed. This is the broadest coverage the
 # witness gets: the targeted smokes above arm single paths; this lane arms
 # everything tier-1 reaches.
-rm -f /tmp/_ballista_witness_t1.json
+rm -f /tmp/_ballista_witness_t1.json.*
 JAX_PLATFORMS=cpu BALLISTA_LOCK_WITNESS=1 \
     BALLISTA_LOCK_WITNESS_OUT=/tmp/_ballista_witness_t1.json \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
-python -m dev.analysis --check-witness /tmp/_ballista_witness_t1.json ballista_tpu
+# tier-1 forks executor/cluster worker processes: each dumped its own
+# <OUT>.<pid> witness; merge them all before the cross-check so an edge
+# seen by ANY process counts against the static graph
+WITNESS_ARGS=()
+for f in /tmp/_ballista_witness_t1.json.*; do
+    WITNESS_ARGS+=(--check-witness "$f")
+done
+python -m dev.analysis "${WITNESS_ARGS[@]}" ballista_tpu

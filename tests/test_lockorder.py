@@ -490,6 +490,81 @@ def test_check_witness_cli_fails_on_recorded_violation(tmp_path):
     assert "RUNTIME VIOLATION" in proc.stdout
 
 
+def test_check_witness_cli_merges_multiple_dumps(tmp_path):
+    """ISSUE 18 satellite: witness CI lanes fork worker processes that
+    each dump <OUT>.<pid>; --check-witness accepts the flag repeatedly
+    and merges the edge sets before the diff — a missed edge in ANY dump
+    fails, duplicate edges collapse to one merged runtime edge."""
+    known = {"src": "scheduler.kv.lock",
+             "dst": "scheduler.state._tenant_mu", "count": 2}
+    a = tmp_path / "w.json.101"
+    a.write_text(json.dumps({"edges": [known], "violations": []}))
+    b = tmp_path / "w.json.102"
+    b.write_text(json.dumps({
+        "edges": [dict(known, count=3),
+                  {"src": "utils.tracing._mu", "dst": "scheduler.kv.lock",
+                   "count": 1}],
+        "violations": [],
+    }))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dev.analysis",
+         "--check-witness", str(a), "--check-witness", str(b),
+         "ballista_tpu", "--json"],
+        cwd=str(REPO), capture_output=True, text=True,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["witness_files"] == 2
+    assert out["missed"] == [["utils.tracing._mu", "scheduler.kv.lock"]]
+    # the duplicated known edge merged into ONE runtime edge
+    assert out["runtime_edges"] == 2
+
+    # both dumps subsets of the static graph: the merged check passes
+    b.write_text(json.dumps({"edges": [dict(known, count=3)],
+                             "violations": []}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dev.analysis",
+         "--check-witness", str(a), "--check-witness", str(b),
+         "ballista_tpu", "--json"],
+        cwd=str(REPO), capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert out["ok"] and out["runtime_edges"] == 1
+
+
+def test_env_armed_witness_dump_is_pid_suffixed(tmp_path):
+    """Env-armed processes dump to <OUT>.<pid>, never <OUT> itself —
+    concurrent workers inheriting one BALLISTA_LOCK_WITNESS_OUT must not
+    clobber each other's atexit os.replace."""
+    import os
+
+    out = tmp_path / "w.json"
+    code = (
+        "from ballista_tpu.utils import locks\n"
+        "a = locks.make_lock('scheduler.kv.lock')\n"
+        "b = locks.make_lock('scheduler.state._tenant_mu')\n"
+        "with a:\n"
+        "    with b:\n"
+        "        pass\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=str(REPO), capture_output=True, text=True,
+        env=dict(os.environ, BALLISTA_LOCK_WITNESS="1",
+                 BALLISTA_LOCK_WITNESS_OUT=str(out),
+                 PYTHONPATH=str(REPO)),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert not out.exists()
+    dumps = list(tmp_path.glob("w.json.*"))
+    assert len(dumps) == 1, dumps
+    rec = lockgraph.load_witness(str(dumps[0]))
+    assert {(e["src"], e["dst"]) for e in rec["edges"]} == {
+        ("scheduler.kv.lock", "scheduler.state._tenant_mu")
+    }
+
+
 # -- parallel analysis (--jobs) ---------------------------------------------
 
 def test_jobs_parallel_matches_serial_and_caches(tmp_path):

@@ -31,6 +31,7 @@ RULES = [
     "decline-discipline",
     "failure-discipline",
     "routing-discipline",
+    "durability",
 ]
 
 
@@ -53,6 +54,7 @@ def test_all_rules_registered():
     for r in RULES:
         assert r in names
     assert "lock-order" in names  # ISSUE 14
+    assert "durability" in names  # ISSUE 18
     assert "lint-usage" in names
 
 
@@ -423,6 +425,62 @@ def test_json_output_and_cache_roundtrip(tmp_path):
     os.utime(work / "mod.py")
     rc3, out3 = run()
     assert rc3 == 0 and out3["ok"], out3["findings"]
+
+
+def test_manifest_edit_invalidates_per_file_cache(tmp_path):
+    """ISSUE 18 satellite: per-file verdicts depend on the durability
+    manifest (owner coverage, [attrs] agreement), so the per-file cache
+    key must incorporate the manifests' content hash — including
+    env-overridden manifests the blob-level analyzer hash never sees.
+    Pre-fix, run 2 served the stale 'clean' verdict from run 1's cache."""
+    work = tmp_path / "pkg"
+    work.mkdir()
+    (work / "mod.py").write_text(
+        "# ballista-lint: path=ballista_tpu/scheduler/mod.py\n"
+        "class Thing:\n"
+        "    def __init__(self):\n"
+        "        self.a = 1\n"
+    )
+    cache = tmp_path / "cache.json"
+    manifest = tmp_path / "durability.toml"
+    env = dict(os.environ, BALLISTA_DURABILITY_MANIFEST=str(manifest))
+
+    def run():
+        proc = subprocess.run(
+            [sys.executable, "-m", "dev.analysis", str(work), "--json",
+             "--cache-file", str(cache)],
+            cwd=str(REPO), capture_output=True, text=True, env=env,
+        )
+        return proc.returncode, json.loads(proc.stdout)
+
+    # manifest v1: Thing is nobody's owner -> the unannotated attr is fine
+    manifest.write_text("[attrs]\n")
+    rc1, out1 = run()
+    assert rc1 == 0 and out1["ok"], out1["findings"]
+    assert out1["stats"]["cache_hits"] == 0
+
+    # manifest v2 makes Thing an owner: the SAME file (same mtime/size)
+    # must be re-analyzed and flag the missing annotation
+    manifest.write_text(
+        "[[owners]]\n"
+        'module = "scheduler.mod"\n'
+        'class = "Thing"\n'
+        "[attrs]\n"
+    )
+    rc2, out2 = run()
+    assert rc2 == 1 and not out2["ok"], out2
+    assert out2["stats"]["cache_hits"] == 0  # stale entry NOT served
+    assert any(
+        f["rule"] == "durability"
+        and "no `# durability:` annotation" in f["message"]
+        for f in out2["findings"]
+    ), out2["findings"]
+
+    # unchanged manifest: the refreshed verdict is served from cache
+    rc3, out3 = run()
+    assert rc3 == 1
+    assert out3["stats"]["cache_hits"] == out3["stats"]["files"] == 1
+    assert out3["findings"] == out2["findings"]
 
 
 def test_suppression_budget_enforced(tmp_path):
