@@ -327,6 +327,16 @@ class BallistaContext(ExecutionContext):
                     )
                 total = None
                 if which == "completed":
+                    # advanced-entry results ride the status itself (ISSUE
+                    # 19): one Arrow IPC stream, checked BEFORE the empty
+                    # location list is read as an empty result
+                    if status.completed.inline_result:
+                        with pa.ipc.open_stream(
+                            pa.BufferReader(status.completed.inline_result)
+                        ) as r:
+                            for batch in r:
+                                yield batch
+                        return
                     locs = list(status.completed.partition_location)
                     total = len(locs)
                 elif which == "running":
@@ -491,6 +501,15 @@ class BallistaContext(ExecutionContext):
         deadline = time.time() + timeout
         while True:
             status = self._wait_for_job(job_id, max(0.0, deadline - time.time()))
+            if status.completed.inline_result:
+                # advanced-entry result (ISSUE 19): the folded table rides
+                # the status inline — nothing to fetch, nothing to lose.
+                # Checked BEFORE the location list, or an inline result
+                # would be misread as an empty table.
+                with pa.ipc.open_stream(
+                    pa.BufferReader(status.completed.inline_result)
+                ) as r:
+                    return r.read_all().cast(schema)
             try:
                 tables = [
                     self._fetch_partition(loc)

@@ -157,10 +157,32 @@ BALLISTA_RESULT_CACHE = "ballista.cache.results"
 # distinct query it ever served.
 BALLISTA_RESULT_CACHE_MAX_ENTRIES = "ballista.cache.results.max_entries"
 BALLISTA_RESULT_CACHE_TTL_S = "ballista.cache.results.ttl_s"
+# result-cache delta advancement (ISSUE 19): on a fingerprint miss whose
+# content_key matches a cached entry and whose scan-file set is a strict
+# SUPERSET of the entry's, plan a delta job over only the NEW files and
+# fold its partials into the entry's stored resumable state instead of
+# recomputing the full scan. Only order-insensitive aggregate shapes are
+# eligible (integer sums, counts, min/max — f32-arithmetic sums and
+# anything non-associative decline to the full run, recorded, never
+# silent); the advanced result is bit-identical to a cold full run.
+BALLISTA_CACHE_ADVANCE = "ballista.cache.advance"
+# internal (scheduler-set, never client-set): present in a delta job's
+# per-job settings, naming the user job whose cached result the delta's
+# output advances. Rides TaskDefinition.settings AND the proto's
+# delta_for field — provenance for logs/telemetry; executors run the
+# task like any other.
+BALLISTA_DELTA_FOR = "ballista.internal.delta_for"
 # cross-job physical-plan sharing (scheduler-side): optimize+physical
 # planning output is content-keyed (fingerprint sans mtimes), so N tenants
 # submitting the same dashboard query plan it once.
 BALLISTA_PLAN_CACHE = "ballista.cache.plans"
+# per-tenant HBM-residency budget (ISSUE 19 satellite, PR 16 residue): max
+# bytes of exchange-registry residency one tenant's published pieces may
+# hold on a chip (0 = unlimited). Enforced BEFORE the cluster-global
+# residency budget, with per-tenant LRU eviction among that tenant's own
+# entries — one tenant's SF=100 shuffle cannot monopolize the registry
+# that another tenant's dashboard queries rely on.
+BALLISTA_TENANT_RESIDENCY_BUDGET = "ballista.tenant.residency_budget_bytes"
 # per-tenant latency SLO deadlines (ISSUE 11): "alice:250,bob:2000" gives
 # alice's jobs a 250ms target. Feeds admission ordering — a tenant whose
 # oldest pending job has blown (or is past) its deadline is visited BEFORE
@@ -333,6 +355,12 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_RESULT_CACHE: "true",
     BALLISTA_RESULT_CACHE_MAX_ENTRIES: "1024",
     BALLISTA_RESULT_CACHE_TTL_S: "0",
+    # advancement defaults OFF: it changes how a repeated query over grown
+    # inputs executes (delta job + fold instead of a full run); the
+    # bit-identity invariant is fuzz-checked but the workload class is
+    # opt-in like streaming ingestion itself
+    BALLISTA_CACHE_ADVANCE: "false",
+    BALLISTA_TENANT_RESIDENCY_BUDGET: "0",
     BALLISTA_PLAN_CACHE: "true",
     BALLISTA_PUSH_DISPATCH: "true",
     BALLISTA_IDLE_POLL_MAX_S: "2",
@@ -623,6 +651,16 @@ class BallistaConfig(Mapping[str, str]):
     def result_cache_ttl_s(self) -> float:
         """Result-cache entry time-to-live in seconds (0 = no expiry)."""
         return max(0.0, float(self._settings[BALLISTA_RESULT_CACHE_TTL_S]))
+
+    def cache_advance(self) -> bool:
+        """Result-cache delta advancement over grown scan-file sets
+        (ISSUE 19). Requires the result cache itself."""
+        return self._settings[BALLISTA_CACHE_ADVANCE].lower() in ("1", "true", "yes")
+
+    def tenant_residency_budget(self) -> int:
+        """Per-tenant exchange-registry residency cap in bytes (0 =
+        unlimited; ISSUE 19 satellite)."""
+        return max(0, int(self._settings[BALLISTA_TENANT_RESIDENCY_BUDGET]))
 
     def plan_cache(self) -> bool:
         return self._settings[BALLISTA_PLAN_CACHE].lower() in ("1", "true", "yes")

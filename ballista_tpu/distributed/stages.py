@@ -146,6 +146,10 @@ class _ExchangeCapture:
         self.map_partition = map_partition
         self.attempt = attempt
         self.budget = ctx.config.residency_budget()
+        # per-tenant residency cap (ISSUE 19 satellite): captured from the
+        # job's config here so the registry's leaf lock never reads config
+        self.tenant = ctx.config.tenant()
+        self.tenant_budget = ctx.config.tenant_residency_budget()
         self.nbytes = 0
         self.overflow = False
         self.pieces: dict = {}  # piece idx -> [RecordBatch]
@@ -185,6 +189,7 @@ class _ExchangeCapture:
                 self.executor_id, self.job_id, self.stage_id,
                 self.map_partition, piece, batches, schema,
                 self.attempt, finals[piece], self.budget,
+                tenant=self.tenant, tenant_budget=self.tenant_budget,
             )
         return kept
 

@@ -253,27 +253,38 @@ def wrap_step(owner, name: str, core, static_argnums: Tuple[int, ...] = (0,)):
     (program, static args, input shapes) signature resolves through:
     in-memory compiled map -> disk artifact -> fresh trace/compile (which
     exports + persists the artifact for the next process), with the
-    serving counters recording which tier served it."""
+    serving counters recording which tier served it.
+
+    The returned callable also carries a ``.warm(*args)`` method:
+    compile-WITHOUT-execute (ISSUE 19 satellite). It traces and
+    XLA-compiles the signature via ``jit(...).lower(...).compile()`` —
+    which primes jax's own executable cache, so the next real call is a
+    cache hit — and registers/persists the AOT artifact, all without
+    running the program: no output buffers are allocated and nothing is
+    pinned past the compile. Background warmers (ops/sharedscan.py) use
+    it so a warm-up never holds transient HBM outside the residency
+    accounting."""
     import jax
     from jax.tree_util import tree_flatten, tree_unflatten
 
     jitfn = jax.jit(core, static_argnums=static_argnums)
     static_set = frozenset(static_argnums)
 
-    def wrapped(*args):
+    def signature(args):
+        """(key, statics, treedef, leaves, avals) for an AOT-cacheable
+        call, or None when the AOT tier must be bypassed (no cache dir,
+        no owner key, or weak-typed leaves whose promotion semantics an
+        exported strong aval could silently change)."""
         key_base = getattr(owner, "aot_key", None)
         with _lock:
             base = _dir
         if not base or key_base is None:
-            return jitfn(*args)
+            return None
         statics = [(i, args[i]) for i in sorted(static_set)]
         dynamic = [a for i, a in enumerate(args) if i not in static_set]
         leaves, treedef = tree_flatten(tuple(dynamic))
         if any(bool(getattr(l, "weak_type", False)) for l in leaves):
-            # a weak-typed leaf changes promotion semantics inside the
-            # trace; exporting it under a strong aval could compile a
-            # subtly different program — bypass the AOT tier for safety
-            return jitfn(*args)
+            return None
         avals = [_leaf_aval(l) for l in leaves]
         sig = (
             f"{name}|s{[(i, repr(v)) for i, v in statics]!r}"
@@ -282,7 +293,44 @@ def wrap_step(owner, name: str, core, static_argnums: Tuple[int, ...] = (0,)):
         key = hashlib.sha256(
             f"{fingerprint()}|{key_base}|{sig}".encode()
         ).hexdigest()
+        return key, statics, treedef, leaves, avals
+
+    def export_and_save(key, statics, treedef, avals, n_args):
+        """Export the traced program to StableHLO + persist it for the
+        next process. Trace-only (stops at StableHLO — measured ~5% of a
+        large unrolled program's XLA compile); never raises."""
+        try:
+            from jax import export as jax_export
+
+            static_vals = dict(statics)
+
+            def flat_fn(*flat_leaves):
+                dyn = tree_unflatten(treedef, flat_leaves)
+                full: List[object] = []
+                di = 0
+                for i in range(n_args):
+                    if i in static_vals:
+                        full.append(static_vals[i])
+                    else:
+                        full.append(dyn[di])
+                        di += 1
+                return core(*full)
+
+            blob = bytes(jax_export.export(jax.jit(flat_fn))(*avals).serialize())
+            with _lock:
+                base = _dir
+            if base:
+                _save_artifact(base, key, name, blob)
+        except Exception as e:
+            log.debug("aot export failed (key=%s...): %s", key[:16], e)
+
+    def wrapped(*args):
+        resolved = signature(args)
+        if resolved is None:
+            return jitfn(*args)
+        key, statics, treedef, leaves, avals = resolved
         with _lock:
+            base = _dir
             entry = _mem.get(key)
         if entry is not None:
             kind, compiled = entry
@@ -310,38 +358,54 @@ def wrap_step(owner, name: str, core, static_argnums: Tuple[int, ...] = (0,)):
         # fresh program: run the PLAIN jit first (its persistent-XLA-cache
         # key matches every compile this codebase ever did, so warm
         # deployments hit it), then export + serialize for the disk tier.
-        # The export costs one extra Python trace but stops at StableHLO —
-        # measured ~5% of a large unrolled program's XLA compile — whereas
-        # compiling THROUGH the exported module here would key the
+        # Compiling THROUGH the exported module here would key the
         # persistent XLA cache differently and recompile from scratch
         # (measured ~15s per big program, a whole-suite stall).
         _record("compile_trace")
         out = jitfn(*args)
         with _lock:
             _mem.setdefault(key, ("fresh", None))
-        try:
-            from jax import export as jax_export
-
-            static_vals = dict(statics)
-
-            def flat_fn(*flat_leaves):
-                dyn = tree_unflatten(treedef, flat_leaves)
-                full: List[object] = []
-                di = 0
-                for i in range(len(args)):
-                    if i in static_vals:
-                        full.append(static_vals[i])
-                    else:
-                        full.append(dyn[di])
-                        di += 1
-                return core(*full)
-
-            blob = bytes(jax_export.export(jax.jit(flat_fn))(*avals).serialize())
-            _save_artifact(base, key, name, blob)
-        except Exception as e:
-            log.debug("aot export failed (key=%s...): %s", key[:16], e)
+        export_and_save(key, statics, treedef, avals, len(args))
         return out
 
+    def warm(*args):
+        """Compile this signature without executing it; True when a
+        compile actually happened (False = already resolvable warm)."""
+        resolved = signature(args)
+        if resolved is None:
+            # no AOT tier for this call: still prime jit's executable
+            # cache so the next real call neither traces nor compiles
+            jitfn.lower(*args).compile()
+            _record("compile_warmed")
+            return True
+        key, statics, treedef, leaves, avals = resolved
+        with _lock:
+            base = _dir
+            if key in _mem:
+                return False
+        blob = _read_artifact(base, key)
+        if blob is not None:
+            try:
+                compiled = _compile_exported(blob, avals)
+            except Exception as e:
+                _record("aot_load_error")
+                log.warning(
+                    "aot artifact %s... failed to compile during warm: %s "
+                    "— compiling fresh", key[:16], e,
+                )
+            else:
+                with _lock:
+                    _mem.setdefault(key, ("disk", compiled))
+                _record("compile_hit_disk")
+                return True
+        jitfn.lower(*args).compile()
+        with _lock:
+            _mem.setdefault(key, ("fresh", None))
+        _record("compile_warmed")
+        export_and_save(key, statics, treedef, avals, len(args))
+        return True
+
+    wrapped.warm = warm
     return wrapped
 
 

@@ -44,15 +44,18 @@ _entries: Dict[Tuple[str, str, int, int, int], "_Entry"] = {}  # guarded-by: _re
 # FetchPartition lookups; guarded-by: _reg_lock
 _by_path: Dict[str, Tuple[str, str, int, int, int]] = {}
 _total_bytes: int = 0  # guarded-by: _reg_lock
+# tenant -> resident bytes (ISSUE 19 satellite): the per-tenant half of
+# the budget ledger, kept exactly in sync with _entries by every mutation
+_tenant_bytes: Dict[str, int] = {}  # guarded-by: _reg_lock
 
 
 class _Entry:
     __slots__ = ("batches", "schema", "nbytes", "attempt", "path",
-                 "saving_s", "last_used")
+                 "saving_s", "last_used", "tenant")
 
     def __init__(self, batches: List[pa.RecordBatch], schema: pa.Schema,
                  nbytes: int, attempt: int, path: str,
-                 saving_s: float) -> None:
+                 saving_s: float, tenant: str = "") -> None:
         self.batches = batches
         self.schema = schema
         self.nbytes = nbytes
@@ -63,6 +66,21 @@ class _Entry:
         # into the cost model while holding the leaf _reg_lock)
         self.saving_s = saving_s
         self.last_used = time.monotonic()
+        self.tenant = tenant
+
+
+# holds-lock: _reg_lock
+def _drop_entry_locked(key: Tuple[str, str, int, int, int]) -> "_Entry":
+    """Remove one entry and settle BOTH byte ledgers (global + tenant)."""
+    global _total_bytes
+    e = _entries.pop(key)
+    _by_path.pop(e.path, None)
+    _total_bytes -= e.nbytes
+    if e.tenant in _tenant_bytes:
+        _tenant_bytes[e.tenant] -= e.nbytes
+        if _tenant_bytes[e.tenant] <= 0:
+            del _tenant_bytes[e.tenant]
+    return e
 
 
 def predicted_transfer_saving_s(nbytes: int) -> float:
@@ -85,7 +103,8 @@ def predicted_transfer_saving_s(nbytes: int) -> float:
 
 def publish(executor_id: str, job_id: str, stage_id: int, map_partition: int,
             piece: int, batches: List[pa.RecordBatch], schema: pa.Schema,
-            attempt: int, path: str, budget: int) -> bool:
+            attempt: int, path: str, budget: int,
+            tenant: str = "", tenant_budget: int = 0) -> bool:
     """Register one published piece's batches; returns whether it was kept.
 
     Called only AFTER the authoritative os.replace publish, so the registry
@@ -93,11 +112,19 @@ def publish(executor_id: str, job_id: str, stage_id: int, map_partition: int,
     budget pressure the incomer displaces least-recently-used entries only
     when its predicted transfer saving exceeds the victims' combined saving
     — otherwise it is skipped and the consumer pays the ordinary ladder.
+
+    ``tenant_budget`` > 0 caps this TENANT's resident bytes (ISSUE 19
+    satellite), enforced BEFORE the global budget with the same
+    cost-gated LRU policy restricted to the tenant's own entries — one
+    tenant's giant shuffle evicts its own cold pieces first and can
+    never displace another tenant's to fit itself.
     """
     from ballista_tpu.ops.runtime import record_exchange
 
     nbytes = sum(b.nbytes for b in batches)
-    if nbytes <= 0 or nbytes > budget:
+    if nbytes <= 0 or nbytes > budget or (
+        0 < tenant_budget < nbytes
+    ):
         record_exchange("skipped_budget")
         return False
     # price the incomer BEFORE the lock: _reg_lock is a leaf and must not
@@ -105,18 +132,18 @@ def publish(executor_id: str, job_id: str, stage_id: int, map_partition: int,
     saving = predicted_transfer_saving_s(nbytes)
     key = (executor_id, job_id, int(stage_id), int(map_partition), int(piece))
     evicted = 0
+    tenant_evicted = 0
     kept = True
     with _reg_lock:
         # leaf lock: nothing else (counters included) is taken while held
         global _total_bytes
-        prior = _entries.pop(key, None)
-        if prior is not None:
+        if key in _entries:
             # re-publish (retry/speculative duplicate): newest attempt wins
-            _total_bytes -= prior.nbytes
-            _by_path.pop(prior.path, None)
-        need = _total_bytes + nbytes - budget
-        if need > 0:
-            victims = sorted(_entries.items(), key=lambda kv: kv[1].last_used)
+            _drop_entry_locked(key)
+
+        def lru_plan(pool, need):
+            """(victim keys, freed, their saving) — LRU-first over pool."""
+            victims = sorted(pool, key=lambda kv: kv[1].last_used)
             freed, victim_saving, victim_keys = 0, 0.0, []
             for vk, ve in victims:
                 if freed >= need:
@@ -124,26 +151,47 @@ def publish(executor_id: str, job_id: str, stage_id: int, map_partition: int,
                 victim_keys.append(vk)
                 freed += ve.nbytes
                 victim_saving += ve.saving_s
-            if freed < need or victim_saving > saving:
-                # cannot fit, or the victims' predicted transfer saving
-                # (priced at the observed h2d/readback rates when they
-                # published) exceeds the incomer's: keep what is warm
-                kept = False
-            else:
-                for vk in victim_keys:
-                    ve = _entries.pop(vk)
-                    _by_path.pop(ve.path, None)
-                    _total_bytes -= ve.nbytes
-                    evicted += 1
+            return victim_keys, freed, victim_saving
+
+        # per-tenant cap first: the tenant may only displace ITSELF
+        if tenant_budget > 0:
+            t_need = _tenant_bytes.get(tenant, 0) + nbytes - tenant_budget
+            if t_need > 0:
+                own = [kv for kv in _entries.items() if kv[1].tenant == tenant]
+                victim_keys, freed, victim_saving = lru_plan(own, t_need)
+                if freed < t_need or victim_saving > saving:
+                    kept = False
+                else:
+                    for vk in victim_keys:
+                        _drop_entry_locked(vk)
+                        tenant_evicted += 1
+        if kept:
+            need = _total_bytes + nbytes - budget
+            if need > 0:
+                victim_keys, freed, victim_saving = lru_plan(
+                    _entries.items(), need
+                )
+                if freed < need or victim_saving > saving:
+                    # cannot fit, or the victims' predicted transfer saving
+                    # (priced at the observed h2d/readback rates when they
+                    # published) exceeds the incomer's: keep what is warm
+                    kept = False
+                else:
+                    for vk in victim_keys:
+                        _drop_entry_locked(vk)
+                        evicted += 1
         if kept:
             entry = _Entry(list(batches), schema, nbytes, attempt, path,
-                           saving)
+                           saving, tenant)
             _entries[key] = entry
             _by_path[path] = key
             _total_bytes += nbytes
+            _tenant_bytes[tenant] = _tenant_bytes.get(tenant, 0) + nbytes
     if not kept:
         record_exchange("skipped_budget")
         return False
+    if tenant_evicted:
+        record_exchange("evicted_tenant_budget", tenant_evicted)
     if evicted:
         record_exchange("evicted_budget", evicted)
     record_exchange("published")
@@ -183,12 +231,9 @@ def evict(executor_id: str, job_id: str, stage_id: int, map_partition: int,
     """Drop one entry (the exchange.evict chaos seam); True if it existed."""
     key = (executor_id, job_id, int(stage_id), int(map_partition), int(piece))
     with _reg_lock:
-        global _total_bytes
-        e = _entries.pop(key, None)
-        if e is None:
+        if key not in _entries:
             return False
-        _by_path.pop(e.path, None)
-        _total_bytes -= e.nbytes
+        _drop_entry_locked(key)
     return True
 
 
@@ -197,11 +242,8 @@ def evict_job(job_id: str) -> int:
     when it removes the job's work dir)."""
     removed = 0
     with _reg_lock:
-        global _total_bytes
         for key in [k for k in _entries if k[1] == job_id]:
-            e = _entries.pop(key)
-            _by_path.pop(e.path, None)
-            _total_bytes -= e.nbytes
+            _drop_entry_locked(key)
             removed += 1
     return removed
 
@@ -233,10 +275,17 @@ def resident_bytes() -> int:
         return _total_bytes
 
 
+def tenant_resident_bytes(tenant: str) -> int:
+    """One tenant's share of the registry (tests + budget observability)."""
+    with _reg_lock:
+        return _tenant_bytes.get(tenant, 0)
+
+
 def reset() -> None:
     """Drop everything (tests)."""
     with _reg_lock:
         global _total_bytes
         _entries.clear()
         _by_path.clear()
+        _tenant_bytes.clear()
         _total_bytes = 0

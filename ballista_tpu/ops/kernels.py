@@ -365,9 +365,17 @@ def resolve_stage(exec_node, ctx) -> Tuple[object, str, str, float]:
         # — a false disk hit either way would be silent corruption)
         if built is not False and not pinned and file_backed:
             built.persist_key = key
+            # chunk-set delta identity (ISSUE 19): the plan display names the
+            # scan DIRECTORY, not the file list, so display+flags is stable
+            # across appends — each prepared chunk keys itself under this
+            # base plus its own (path, mtime, size, chunk_index), letting a
+            # grown file set reuse every existing chunk byte-for-byte.
+            chunk_base = exec_node.display_indent() + "|" + flags
+            built.chunk_key_base = chunk_base
             inner = getattr(built, "inner", None)
             if inner is not None:
                 inner.persist_key = key
+                inner.chunk_key_base = chunk_base
         if built is not False:
             # AOT program identity is the STABLE key half (no mtimes):
             # compiled programs depend on plan structure + shapes only

@@ -46,8 +46,11 @@ import numpy as np
 # (append-only when non-default), and shared-scan eligibility RELIES on a
 # warm entry being at the dispatching batch granularity — a v4 store may
 # hold suffix-less entries written at ANY batch size, so it is orphaned
-# wholesale rather than trusted.
-_FORMAT = 5
+# wholesale rather than trusted. v6 (ISSUE 19): parquet-backed batch
+# entries move from one-blob-per-(file set, partition) to one entry per
+# (path, mtime, size, chunk_index) so appends re-prepare only new chunks;
+# whole-set v5 blobs would shadow the chunk store, so they are orphaned.
+_FORMAT = 6
 
 
 def cache_dir_for(base: str, stage_key: str, partition: int) -> str:

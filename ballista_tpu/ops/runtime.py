@@ -963,6 +963,35 @@ def exchange_stats(reset: bool = False) -> Dict[str, int]:
     return out
 
 
+# accumulated incremental-execution events (ISSUE 19): chunks_reused =
+# prepared chunks served byte-for-byte from the chunk-set delta store,
+# chunks_prepared = chunks that paid the scan/encode pipeline,
+# bytes_reprepared_saved = staged bytes those reused chunks would have
+# re-encoded, save_declined_midappend = chunk saves refused because the
+# file's identity moved between the stat and the read (fail-closed bugfix),
+# advance_hits = cached results advanced by a delta fold instead of a full
+# recompute, advance_declined = advancement attempts that fell back to the
+# full run (ineligible shape, torn advance, delta-job failure — recorded,
+# never silent). Same in-process accumulator pattern as the counters above.
+_delta_lock = make_lock("ops.runtime._delta_lock")
+# guarded-by: _delta_lock
+_delta: Dict[str, int] = {}  # event -> count
+
+
+def record_delta(event: str, n: int = 1) -> None:
+    with _delta_lock:
+        _delta[event] = _delta.get(event, 0) + int(n)
+
+
+def delta_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot of accumulated incremental-execution counters."""
+    with _delta_lock:
+        out = dict(_delta)
+        if reset:
+            _delta.clear()
+    return out
+
+
 # accumulated elastic-fleet events (ISSUE 15): autoscaler evaluations and
 # the scale actions they took (scale_up / scale_down by executor count,
 # scale_chaos_skipped = fleet.scale-torn decisions, drain_completed /

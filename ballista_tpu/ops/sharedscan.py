@@ -706,13 +706,13 @@ def _combined_ready(sig: tuple) -> bool:
 def _warm_combined(sig: tuple, stages: list, stables: List[str], args) -> None:
     """Trace + compile the composition's combined program OFF the serving
     path (one background thread per signature; XLA compilation releases
-    the GIL). The warm call runs the program once on the wave's real
-    arguments — its result is discarded, only the jit/AOT caches matter —
-    and then marks the signature ready for the next wave. KNOWN COST: the
-    warm execution pins the wave's shared device buffers and allocates the
-    program's output outside the HBM-budget accounting for its duration
-    (compile-without-execute needs lowering plumbing wrap_step doesn't
-    expose yet — ROADMAP residue)."""
+    the GIL). Compile-WITHOUT-execute (ISSUE 19 satellite): the warm goes
+    through ``step.warm`` — ``jit(...).lower(...).compile()`` under the
+    AOT wrapper — so the program never RUNS during warm-up: no output is
+    allocated and the wave's shared device buffers are released as soon
+    as the trace finishes, closing the transient-HBM accounting gap the
+    execute-to-warm approach had. The signature is marked ready for the
+    next wave once the executable exists."""
     with _combined_lock:
         if sig in _combined_warm or sig in _combined_warming:
             return
@@ -721,10 +721,7 @@ def _warm_combined(sig: tuple, stages: list, stables: List[str], args) -> None:
     def run() -> None:
         try:
             step = _combined_step(stages, stables)
-            out = step(*args)
-            if hasattr(out, "block_until_ready"):
-                # ballista-lint: disable=readback-discipline -- warmup launch: the result is discarded on device (sync only, nothing crosses d2h), so there is no readback to account
-                out.block_until_ready()
+            step.warm(*args)
             with _combined_lock:
                 _combined_warm.add(sig)
         except Exception:

@@ -527,6 +527,13 @@ class FusedAggregateStage:
         # flags), set by kernels.hash_aggregate for file-backed stages only;
         # keys the persisted layout cache (ops/layout_cache.py)
         self.persist_key: Optional[str] = None
+        # chunk-set delta base (ISSUE 19): plan display + config flags with
+        # the file list AND mtimes excluded, set beside persist_key by
+        # kernels.resolve_stage. Each prepared chunk persists under
+        # chunk_key_base + its own (path, mtime, size, chunk_index), so an
+        # appended file re-prepares only its own chunks. None = whole-set
+        # persistence only.
+        self.chunk_key_base: Optional[str] = None
         # STABLE half of the stage cache key (no mtimes — compiled programs
         # are data-independent), set by kernels.hash_aggregate for every
         # dispatched stage; keys the persistent AOT program cache
@@ -915,23 +922,10 @@ class FusedAggregateStage:
         else:
             parts = [partition]
         if isinstance(self.scan, ParquetScanExec):
-            import pyarrow.parquet as pq
-
             from ballista_tpu.ops.runtime import ordered_map
 
-            names = self.scan.schema().names
-            strings = [
-                f.name
-                for f in self.scan.schema()
-                if pa.types.is_string(f.type) or pa.types.is_large_string(f.type)
-            ]
-
             def read_one(p: int) -> pa.Table:
-                return pq.read_table(
-                    self.scan.source.files[p],
-                    columns=names,
-                    read_dictionary=strings,
-                ).combine_chunks()
+                return self._read_scan_file(self.scan.source.files[p], ctx)
 
             # multi-file (scan_stride) reads are independent: decode up to
             # `workers` files concurrently, yielding tables in file order so
@@ -944,6 +938,23 @@ class FusedAggregateStage:
             return
         for p in parts:
             yield from self.scan.execute(p, ctx)
+
+    def _read_scan_file(self, path: str, ctx) -> pa.Table:
+        """Eager parquet read of one scan file (dictionary pages map straight
+        to codes). Factored out of _scan_batches so the chunk-delta prepare
+        reads per file — and so tests can interpose a mid-append mutation
+        between the identity stat and the read (ISSUE 19 bugfix)."""
+        import pyarrow.parquet as pq
+
+        names = self.scan.schema().names
+        strings = [
+            f.name
+            for f in self.scan.schema()
+            if pa.types.is_string(f.type) or pa.types.is_large_string(f.type)
+        ]
+        return pq.read_table(
+            path, columns=names, read_dictionary=strings
+        ).combine_chunks()
 
     def _check_int_ranges(self, batch_cols, n: int) -> None:
         """Integer sums accumulate in int32 on device; decline when a masked
@@ -1025,15 +1036,25 @@ class FusedAggregateStage:
 
         from ballista_tpu.ops.runtime import pipelined_map, record_ingest
 
+        persisting = (
+            bool(ctx.config.tpu_layout_cache_dir())
+            and self.persist_key is not None
+        )
+        if (
+            persisting
+            and getattr(self, "chunk_key_base", None) is not None
+            and isinstance(self.scan, ParquetScanExec)
+        ):
+            # chunk-set delta store (ISSUE 19): persist/reuse per
+            # (path, mtime, size, chunk_index) instead of one blob per
+            # whole file set — appending a file re-prepares only its own
+            # chunks and every existing tile loads byte-for-byte
+            return self._prepare_partition_chunks(partition, ctx)
         t_wall0 = _time.perf_counter()
         scan_s = 0.0
         encode_s = 0.0
         upload_s = 0.0
         src_times: List[float] = []  # appended by the reader thread only
-        persisting = (
-            bool(ctx.config.tpu_layout_cache_dir())
-            and self.persist_key is not None
-        )
         records: List[dict] = []
         entries: List[dict] = []
         # all of a partition's batch entries are live on device at once
@@ -1160,6 +1181,323 @@ class FusedAggregateStage:
             arrays=arrays,
             cap_bytes=ctx.config.tpu_layout_cache_cap(),
         )
+
+    # -- chunk-set delta store (ISSUE 19) -------------------------------
+    #
+    # The whole-set batches entry above keys on (plan, file set, mtimes):
+    # appending ONE parquet file to a growing directory orphans the entry
+    # and re-pays the full scan/decode/encode pipeline. The methods below
+    # instead persist each prepared chunk under its OWN identity —
+    # (path, mtime, size, chunk_index) beneath the mtime-free
+    # chunk_key_base — so a query over files ∪ {new} re-prepares only the
+    # new file's chunks and loads every existing tile byte-for-byte.
+
+    def _chunk_context(self) -> str:
+        """Hash of the cross-file prepare state a chunk's tiles bake in:
+        the sticky narrow choices and every string dictionary's code->value
+        mapping as they stood when the file's first chunk was consumed.
+        Part of the chunk key: a file set whose sort order interleaves a
+        NEW file before an old one shifts the old file's dictionary codes,
+        and keying on the context makes that a clean miss (one re-prepare,
+        re-saved under the new context) instead of a poisoned hit or a
+        permanently unloadable entry."""
+        import hashlib
+
+        h = hashlib.sha256()
+        for k in sorted(self._narrow_choice, key=str):
+            h.update(f"n|{k}={self._narrow_choice[k]}\x00".encode())
+        for idx in sorted(self.dicts.dicts):
+            snap = self.dicts.dicts[idx].snapshot()
+            if snap is None:
+                continue
+            h.update(f"d|{idx}\x00".encode())
+            for v in snap.to_pylist():
+                h.update(repr(v).encode())
+                h.update(b"\x00")
+        return h.hexdigest()[:20]
+
+    def _chunk_stage_key(self, ident: Tuple[str, str, int], context: str) -> str:
+        path, mtime, size = ident
+        return (
+            f"chunk|{self.chunk_key_base}|ctx={context}|{path}|{mtime}|{size}"
+        )
+
+    # holds-lock: self._prepare_lock
+    def _prepare_partition_chunks(self, partition: int, ctx) -> List[dict]:
+        """Chunk-granular variant of _prepare_partition for parquet-backed
+        stages with a delta identity: walk the partition's files in order,
+        loading each file's persisted chunks when its (path, mtime, size)
+        identity and prepare context match, preparing (and persisting) only
+        the files that miss. Batch order — and therefore dictionary code
+        assignment, narrow choices, and the device batch stream — is
+        identical to the serial whole-set prepare."""
+        import os
+        import time as _time
+
+        from ballista_tpu.ops.runtime import record_delta, record_ingest
+
+        t_wall0 = _time.perf_counter()
+        if self.scan_stride is not None:
+            total = self.scan.output_partitioning().partition_count()
+            parts = range(partition, total, self.scan_stride)
+        else:
+            parts = [partition]
+        budget = ctx.config.tpu_hbm_budget()
+        entries: List[dict] = []
+        # cumulative timings + staged-bytes budget ledger shared with the
+        # per-file prepare (mirrors _prepare_partition's accounting)
+        totals = {"bytes": 0, "scan_s": 0.0, "encode_s": 0.0, "upload_s": 0.0}
+        for p in parts:
+            path = self.scan.source.files[p]
+            try:
+                st = os.stat(path)
+                ident = (path, str(st.st_mtime), int(st.st_size))
+            except OSError:
+                ident = None
+            context = self._chunk_context()
+            loaded = (
+                self._load_file_chunks(ident, context, ctx)
+                if ident is not None
+                else None
+            )
+            if loaded is not None:
+                records, nbytes = loaded
+                totals["bytes"] += nbytes
+                if totals["bytes"] > budget:
+                    raise UnsupportedOnDevice(
+                        f"stage batches ({totals['bytes'] >> 20} MiB) "
+                        f"exceed the HBM budget"
+                    )
+                t_up0 = _time.perf_counter()
+                reused = 0
+                for rec in records:
+                    if rec is None:  # empty-chunk marker
+                        continue
+                    entries.append(self._upload_record(rec, budget, totals))
+                    reused += 1
+                totals["upload_s"] += _time.perf_counter() - t_up0
+                record_delta("chunks_reused", reused)
+                record_delta("bytes_reprepared_saved", nbytes)
+                continue
+            self._prepare_file_chunks(
+                p, ident, context, ctx, entries, totals, budget
+            )
+        record_ingest(
+            totals["scan_s"], totals["encode_s"], totals["upload_s"],
+            _time.perf_counter() - t_wall0,
+        )
+        return entries
+
+    def _upload_record(self, rec: dict, budget: int, totals: dict) -> dict:
+        import jax.numpy as jnp
+
+        make_headroom(self, totals["bytes"], budget)
+        cols = _upload_staged(rec["staged"], self._narrow_choice)
+        return {
+            "n_groups": rec["n_groups"],
+            "seg_bucket": rec["seg_bucket"],
+            "cols": cols,
+            "codes": jnp.asarray(rec["codes_pad"]),
+            "row_valid": jnp.asarray(rec["row_valid"]),
+            "key_values": rec["key_values"],
+        }
+
+    def _load_file_chunks(self, ident, context: str, ctx):
+        """Load ONE file's persisted chunk set. Returns (records, bytes) —
+        records in chunk order, None marking empty chunks — or None on any
+        miss. All-or-nothing: every chunk must be present, carry the exact
+        identity stamped at save time (a torn mid-append writer is caught
+        by the save-side re-stat, this is the load-side belt), and adopt
+        its dictionary snapshot cleanly, else the whole file re-prepares."""
+        from ballista_tpu.ops import layout_cache as lc
+
+        base = ctx.config.tpu_layout_cache_dir()
+        skey = self._chunk_stage_key(ident, context)
+        hit = lc.load_entry(base, skey, 0)
+        if hit is None:
+            return None
+        n_chunks = hit[0].get("n_chunks")
+        if not isinstance(n_chunks, int) or n_chunks < 1:
+            return None
+        records: List[Optional[dict]] = []
+        total = 0
+        for ci in range(n_chunks):
+            if hit is None:
+                hit = lc.load_entry(base, skey, ci)
+            if hit is None:
+                return None
+            meta, arrays = hit
+            hit = None
+            if (
+                meta.get("kind") != "chunk"
+                or meta.get("ident") != list(ident)
+                or meta.get("n_chunks") != n_chunks
+            ):
+                return None
+            try:
+                if not lc.adopt_dict_snapshot(self.dicts, meta["dicts"], arrays):
+                    return None
+            except Exception:
+                return None
+            if meta.get("empty"):
+                records.append(None)
+                continue
+            try:
+                unpacked = _unpack_staged(
+                    meta["cols"], arrays, self._narrow_choice
+                )
+                if unpacked is None:
+                    return None
+                staged, nbytes = unpacked
+                rec = {
+                    "n_groups": int(meta["n_groups"]),
+                    "seg_bucket": int(meta["seg_bucket"]),
+                    "staged": staged,
+                    "codes_pad": arrays[meta["codes"]],
+                    "row_valid": arrays[meta["row_valid"]],
+                    "key_values": lc.unpack_arrow_arrays(arrays[meta["keys"]]),
+                }
+            except Exception:
+                return None
+            total += nbytes + rec["codes_pad"].nbytes + rec["row_valid"].nbytes
+            records.append(rec)
+        return records, total
+
+    def _prepare_file_chunks(
+        self, p: int, ident, context: str, ctx,
+        entries: List[dict], totals: dict, budget: int,
+    ) -> None:
+        """Prepare one file fresh, persisting each consumed chunk under its
+        own (path, mtime, size, chunk_index) entry as it goes. Mid-append
+        fail-closed (ISSUE 19 bugfix): the file is re-statted AFTER the
+        read — if its identity moved between the stat and the read, the
+        bytes just decoded may not be the state `ident` describes, and
+        persisting them would poison the entry for every later process
+        whose fingerprint resolved at the old mtime. The in-memory prepare
+        still uses the data (same exposure as the whole-set path); only
+        the save is declined, and recorded."""
+        import os
+        import time as _time
+
+        from ballista_tpu.ops import layout_cache as lc
+        from ballista_tpu.ops.runtime import pipelined_map, record_delta
+
+        path = self.scan.source.files[p]
+        t0 = _time.perf_counter()
+        table = self._read_scan_file(path, ctx)
+        totals["scan_s"] += _time.perf_counter() - t0
+        save = ident is not None
+        if save:
+            try:
+                st = os.stat(path)
+                if (str(st.st_mtime), int(st.st_size)) != (ident[1], ident[2]):
+                    save = False
+                    record_delta("save_declined_midappend")
+            except OSError:
+                save = False
+        base = ctx.config.tpu_layout_cache_dir()
+        cap = ctx.config.tpu_layout_cache_cap()
+        skey = self._chunk_stage_key(ident, context) if save else None
+        chunks = table.to_batches(max_chunksize=ctx.batch_size)
+        n_chunks = max(len(chunks), 1)
+
+        def _save_chunk(ci: int, body: Optional[dict], staged) -> None:
+            if not save:
+                return
+            arrays: List[np.ndarray] = []
+            meta = {
+                "kind": "chunk",
+                "ident": list(ident),
+                "n_chunks": n_chunks,
+            }
+            if body is None:
+                meta["empty"] = True
+            else:
+                meta["cols"] = _pack_staged(staged, arrays)
+                meta["n_groups"] = body["n_groups"]
+                meta["seg_bucket"] = body["seg_bucket"]
+                meta["codes"] = len(arrays)
+                arrays.append(body["codes_pad"])
+                meta["row_valid"] = len(arrays)
+                arrays.append(body["row_valid"])
+                meta["keys"] = len(arrays)
+                arrays.append(lc.pack_arrow_arrays(body["key_values"]))
+            # cumulative snapshot AFTER this chunk's encode: a loader that
+            # adopted every prior chunk in order holds exactly a prefix
+            dmeta, darrays = lc.pack_dict_snapshot(self.dicts)
+            offset = len(arrays)
+            meta["dicts"] = {k: v + offset for k, v in dmeta.items()}
+            arrays.extend(darrays)
+            meta["n_arrays"] = len(arrays)
+            lc.save_entry(base, skey, ci, meta, arrays, cap)
+
+        def _prefetch(item):
+            ci, batch = item
+            if batch.num_rows == 0:
+                return ci, batch, None, None, 0, 0.0
+            t0 = _time.perf_counter()
+            codes, key_values, n_groups = self._group_codes(batch)
+            return (
+                ci, batch, codes, key_values, n_groups,
+                _time.perf_counter() - t0,
+            )
+
+        for ci, batch, codes, key_values, n_groups, dt in pipelined_map(
+            iter(enumerate(chunks)), _prefetch,
+            ctx.config.tpu_ingest_workers(), ctx.config.tpu_ingest_depth(),
+        ):
+            totals["scan_s"] += dt
+            n = batch.num_rows
+            if n == 0 or n_groups == 0:
+                _save_chunk(ci, None, None)
+                continue
+            if n_groups > MAX_GROUPS:
+                # partial chunk set stays on disk; the all-chunks-present
+                # load check fails it closed
+                raise TooManyGroups(f"{n_groups} groups exceeds unrolled path")
+            bucket = bucket_rows(n)
+            t_enc0 = _time.perf_counter()
+            npcols = self._lower_columns(batch)
+            self._check_int_ranges(npcols, n)
+            staged: Dict[int, tuple] = {}
+            for idx in list(npcols):
+                npcol = npcols.pop(idx)
+                fill = False if npcol.dtype == np.bool_ else 0
+                narrow, lut, choice = narrow_column(
+                    npcol, self._narrow_choice.get(idx)
+                )
+                del npcol
+                padded = pad_to(narrow, bucket, fill)
+                staged[idx] = (padded, lut, choice)
+                totals["bytes"] += (
+                    padded.nbytes + (0 if lut is None else lut.nbytes)
+                )
+            totals["bytes"] += 3 * bucket  # int16 codes + bool row_valid
+            if totals["bytes"] > budget:
+                raise UnsupportedOnDevice(
+                    f"stage batches ({totals['bytes'] >> 20} MiB) exceed "
+                    f"the HBM budget"
+                )
+            seg_bucket = bucket_rows(n_groups, 16) + 1  # +1 dump slot
+            codes_pad = pad_to(codes.astype(np.int16), bucket, 0)
+            row_valid = np.zeros(bucket, dtype=np.bool_)
+            row_valid[:n] = True
+            rec = {
+                "n_groups": int(n_groups),
+                "seg_bucket": int(seg_bucket),
+                "codes_pad": codes_pad,
+                "row_valid": row_valid,
+                "key_values": key_values,
+            }
+            totals["encode_s"] += _time.perf_counter() - t_enc0
+            _save_chunk(ci, rec, staged)
+            t_up0 = _time.perf_counter()
+            rec["staged"] = staged
+            entries.append(self._upload_record(rec, budget, totals))
+            totals["upload_s"] += _time.perf_counter() - t_up0
+            record_delta("chunks_prepared")
+        if not chunks:
+            _save_chunk(0, None, None)
 
     def _load_batches_layout(self, meta: dict, arrays: List[np.ndarray],
                              ctx) -> Optional[dict]:

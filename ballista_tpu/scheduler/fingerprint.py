@@ -71,30 +71,26 @@ def _has_volatile_fn(msg) -> bool:
 
 def _settings_component(settings: Dict[str, str]) -> str:
     """Result-affecting settings, canonically ordered. Tenancy keys are
-    excluded (tenants share cache lines); everything else a client set
-    participates — backend choice, batch size, chaos arming etc. can all
-    change result bytes or execution shape, and a false cache hit across
-    them would be silent corruption."""
+    excluded (tenants share cache lines); so is ballista.cache.advance —
+    advancement is bit-identical to a cold run by contract (ISSUE 19), so
+    advance-on and advance-off clients must share content keys. Everything
+    else a client set participates — backend choice, batch size, chaos
+    arming etc. can all change result bytes or execution shape, and a
+    false cache hit across them would be silent corruption."""
     items = sorted(
         (k, v) for k, v in settings.items()
         if not k.startswith("ballista.tenant.")
+        and k != "ballista.cache.advance"
     )
     return ";".join(f"{k}={v}" for k, v in items)
 
 
-def plan_fingerprint(
-    plan: lp.LogicalPlan, settings: Dict[str, str]
-) -> Optional[Tuple[str, str]]:
-    """(content_key, result_key) for a fully identifiable plan, else None.
-
-    content_key: sha256 over (plan proto bytes, result-affecting settings).
-    result_key:  sha256 over (content_key, sorted (path, mtime, size) of
-    every scan file) — the result-cache identity with mtime invalidation
-    built into the key.
-    """
-    from ballista_tpu.proto import ballista_pb2 as pb  # noqa: F401
-    from ballista_tpu.serde.logical import plan_to_proto
-
+def plan_file_facts(plan: lp.LogicalPlan) -> Optional[list]:
+    """Every scan file's ``path|mtime|size`` fact, or None when any source
+    is neither file-backed nor content-embedded (or a file is unstattable).
+    The facts are the per-file half of ``result_key`` — and the unit of the
+    advancement probe (ISSUE 19): a cached entry whose fact set is a strict
+    subset of a new submission's facts covers a prefix of its inputs."""
     file_facts = []
     for src in _walk_sources(plan):
         files = getattr(src, "files", None)
@@ -111,6 +107,31 @@ def plan_fingerprint(
             continue
         else:
             return None  # neither file-backed nor content-embedded
+    return file_facts
+
+
+def plan_fingerprint(
+    plan: lp.LogicalPlan, settings: Dict[str, str], file_facts=None
+) -> Optional[Tuple[str, str]]:
+    """(content_key, result_key) for a fully identifiable plan, else None.
+
+    content_key: sha256 over (plan proto bytes, result-affecting settings).
+    result_key:  sha256 over (content_key, sorted (path, mtime, size) of
+    every scan file) — the result-cache identity with mtime invalidation
+    built into the key.
+
+    Pass `file_facts` (from plan_file_facts) when the caller already holds
+    them, so the key and the caller's fact set are built from ONE stat per
+    file — a file rewritten between two stats must not leave a cache entry
+    whose scan_fact disagrees with the result_key it sits under.
+    """
+    from ballista_tpu.proto import ballista_pb2 as pb  # noqa: F401
+    from ballista_tpu.serde.logical import plan_to_proto
+
+    if file_facts is None:
+        file_facts = plan_file_facts(plan)
+    if file_facts is None:
+        return None
     try:
         proto = plan_to_proto(plan)
     except Exception:

@@ -237,7 +237,10 @@ class SchedulerServer:
 
         from ballista_tpu.config import BALLISTA_TENANT, BALLISTA_TENANT_PRIORITY
         from ballista_tpu.ops.runtime import record_tenancy
-        from ballista_tpu.scheduler.fingerprint import plan_fingerprint
+        from ballista_tpu.scheduler.fingerprint import (
+            plan_file_facts,
+            plan_fingerprint,
+        )
 
         # tenancy (ISSUE 7): the proto field is authoritative; settings keep
         # wire compat with clients that only flow the config map
@@ -252,10 +255,14 @@ class SchedulerServer:
             priority = 0
 
         # plan-fingerprint identity (None when any source is neither
-        # file-backed nor content-embedded — such plans never cache)
+        # file-backed nor content-embedded — such plans never cache). The
+        # facts are statted ONCE and shared with the key derivation, so
+        # the stored scan_fact set always agrees with the result_key.
         fp = None
+        facts = None
         if config.result_cache() or config.plan_cache():
-            fp = plan_fingerprint(plan, settings)
+            facts = plan_file_facts(plan)
+            fp = plan_fingerprint(plan, settings, file_facts=facts)
         if fp is None and config.result_cache():
             record_tenancy("cache_unkeyable")
 
@@ -283,6 +290,16 @@ class SchedulerServer:
                         job_id, tenant or "<default>", fp[1][:16],
                     )
                     return pb.ExecuteQueryResult(job_id=job_id)
+                # miss: result-cache advancement (ISSUE 19) — a live
+                # same-content entry over a strict SUBSET of this
+                # submission's scan files can be folded forward with a
+                # delta job over only the new files, instead of paying a
+                # full recompute. Probed under the same lock, so the job
+                # publish cannot interleave with a concurrent put.
+                if config.cache_advance() and facts is not None:
+                    if self._try_advance(job_id, plan, config, settings,
+                                         tenant, priority, fp, facts):
+                        return pb.ExecuteQueryResult(job_id=job_id)
 
         queued = pb.JobStatus()
         queued.queued.SetInParent()
@@ -293,6 +310,11 @@ class SchedulerServer:
         self.state.save_job_tenant(job_id, tenant, priority)
         if fp is not None and config.result_cache():
             self.state.save_job_fingerprint(job_id, fp[1])
+            if facts is not None:
+                # advancement identity (ISSUE 19): the completion-time
+                # cache put stamps these onto the entry, making it a
+                # candidate fold base for later grown-file-set submissions
+                self.state.save_job_facts(job_id, fp[0], facts)
 
         content_key = fp[0] if (fp is not None and config.plan_cache()) else None
         if self.synchronous_planning:
@@ -350,6 +372,156 @@ class SchedulerServer:
                 failed.failed.error = f"planning failed: {e}"
                 self.state.save_job_metadata(job_id, failed)
                 return
+
+    # -- result-cache advancement (ISSUE 19) --------------------------------
+    def _try_advance(
+        self, job_id, plan, config, settings, tenant, priority, fp, facts
+    ) -> bool:
+        """Called UNDER the global KV lock on a result-cache miss: when a
+        fold base exists and the plan's aggregate state is resumable,
+        publish the user job (queued) and hand it to the advancement
+        worker. Returns False to fall through to ordinary planning. A base
+        that exists but cannot fold (float sums, DISTINCT, no total
+        order…) is a recorded decline — never a silent one."""
+        from ballista_tpu.ops.runtime import record_delta
+        from ballista_tpu.scheduler import delta as delta_mod
+
+        base = self.state.result_cache_probe_advance(fp[0], facts)
+        if base is None:
+            return False
+        spec = delta_mod.fold_spec(plan)
+        if spec is None:
+            record_delta("advance_declined")
+            return False
+        new_files = delta_mod.new_scan_files(facts, list(base.scan_fact))
+        if not new_files:
+            return False
+        queued = pb.JobStatus()
+        queued.queued.SetInParent()
+        self.state.save_job_metadata(job_id, queued)
+        self.state.save_job_settings(job_id, settings)
+        self.state.save_job_tenant(job_id, tenant, priority)
+        self.state.save_job_fingerprint(job_id, fp[1])
+        self.state.save_job_facts(job_id, fp[0], facts)
+        log.info(
+            "job %s advancing cached result (epoch %d, +%d file(s), fp=%s...)",
+            job_id, base.advance_epoch, len(new_files), fp[1][:16],
+        )
+        threading.Thread(
+            target=self._advance_job_safe,
+            args=(job_id, plan, config, settings, tenant, priority, fp,
+                  facts, base, new_files, spec),
+            daemon=True,
+        ).start()
+        return True
+
+    def _advance_job_safe(
+        self, job_id, plan, config, settings, tenant, priority, fp, facts,
+        base, new_files, spec,
+    ) -> None:
+        """Advancement worker: run one delta job per new file through the
+        ORDINARY planning machinery (ledger, retries, speculation and
+        recovery all apply to its tasks), fold the delta outputs into the
+        cached base, publish the advanced entry under the grown set's
+        result_key, and complete the user job with the folded result
+        inline. ANY failure — a failed delta job, an unfetchable base, a
+        chaos-torn publish — declines: recorded, logged, and the user job
+        replans as a full recompute, so the fold is only ever an
+        accelerator on a path whose fallback is the bit-identical truth."""
+        import time as _time
+
+        from ballista_tpu.config import BALLISTA_DELTA_FOR
+        from ballista_tpu.ops.runtime import record_delta
+        from ballista_tpu.scheduler import delta as delta_mod
+
+        content_key = fp[0] if config.plan_cache() else None
+
+        def fall_back(reason: str) -> None:
+            record_delta("advance_declined")
+            log.warning("advancement of job %s declined (%s); planning a "
+                        "full recompute", job_id, reason)
+            self._plan_job_safe(job_id, plan, config, content_key)
+
+        try:
+            schema = plan.schema()
+            delta_jobs = []
+            for f in new_files:
+                dj = _job_id()
+                dsettings = dict(settings)
+                dsettings[BALLISTA_DELTA_FOR] = job_id
+                queued = pb.JobStatus()
+                queued.queued.SetInParent()
+                with self.state.kv.lock():
+                    # no jobfp/jobfacts: a delta job's partial result must
+                    # never enter the result cache under any key
+                    self.state.save_job_metadata(dj, queued)
+                    self.state.save_job_settings(dj, dsettings)
+                    self.state.save_job_tenant(dj, tenant, priority)
+                threading.Thread(
+                    target=self._plan_job_safe,
+                    args=(dj, delta_mod.build_delta_plan(plan, f), config,
+                          None),
+                    daemon=True,
+                ).start()
+                delta_jobs.append(dj)
+            deadline = _time.time() + 600.0
+            delta_tables = []
+            for dj in delta_jobs:
+                while True:
+                    if self.crashed:
+                        return  # the successor owns the job's fate now
+                    st = self.state.get_job_metadata(dj)
+                    which = st.WhichOneof("status") if st else None
+                    if which == "completed":
+                        break
+                    if which == "failed":
+                        return fall_back(
+                            f"delta job {dj} failed: {st.failed.error}"
+                        )
+                    if _time.time() > deadline:
+                        return fall_back(f"delta job {dj} timed out")
+                    _time.sleep(0.005)
+                delta_tables.append(delta_mod.fetch_completed_table(
+                    st.completed.partition_location, config, schema
+                ))
+            if base.state_ipc:
+                base_table = delta_mod.ipc_to_table(base.state_ipc)
+            else:
+                base_table = delta_mod.fetch_completed_table(
+                    base.partition_location, config, schema
+                )
+            folded = delta_mod.fold_tables(
+                [base_table] + delta_tables, spec, schema
+            )
+            ipc = delta_mod.table_to_ipc(folded)
+            with self.state.kv.lock():
+                if self.crashed:
+                    return
+                published = self.state.result_cache_put_advanced(
+                    fp[1], fp[0], facts, ipc, base.advance_epoch
+                )
+                if published:
+                    record_delta("advance_hits")
+                    completed = pb.JobStatus()
+                    completed.completed.cached = True
+                    completed.completed.inline_result = ipc
+                    self.state.save_job_metadata(job_id, completed)
+                    self.state._note_job_slo(job_id)
+            if not published:
+                # outside the KV lock: the fallback replans through the
+                # plan cache, whose mutex must never nest under the store
+                return fall_back("publish torn by chaos")
+            log.info(
+                "job %s advanced from cached base (epoch %d -> %d, %d delta "
+                "file(s), fp=%s...)",
+                job_id, base.advance_epoch, base.advance_epoch + 1,
+                len(new_files), fp[1][:16],
+            )
+        except Exception as e:
+            if self.crashed:
+                return
+            log.exception("advancement of job %s failed", job_id)
+            fall_back(str(e))
 
     def _physical_plan(self, plan, config, content_key=None):
         """Optimize + physical-plan, through the cross-job plan cache when a
@@ -441,6 +613,8 @@ class SchedulerServer:
         so the executor cannot tell them apart."""
         from ballista_tpu.serde.physical import phys_plan_to_proto
 
+        from ballista_tpu.config import BALLISTA_DELTA_FOR
+
         td = pb.TaskDefinition()
         td.task_id.CopyFrom(status.partition_id)
         td.attempt = status.attempt
@@ -449,6 +623,9 @@ class SchedulerServer:
             status.partition_id.job_id
         ).items():
             td.settings.add(key=k, value=v)
+            if k == BALLISTA_DELTA_FOR:
+                # delta provenance (ISSUE 19) rides first-class too
+                td.delta_for = v
         return td
 
     def _close_subscriber(self, sub: _PushSubscriber) -> None:

@@ -98,6 +98,12 @@ def _record_routing(engine: str, op: str = "", predicted_s=None,
     record_routing(engine, op, predicted_s, observed_s)
 
 
+def _record_delta(event: str, n: int = 1) -> None:
+    from ballista_tpu.ops.runtime import record_delta
+
+    record_delta(event, n)
+
+
 def _record_shuffle_tier(event: str, n: int = 1) -> None:
     from ballista_tpu.ops.runtime import record_shuffle_tier
 
@@ -764,7 +770,27 @@ class SchedulerState:
         v = self.kv.get(self._key("jobfp", job_id))
         return v.decode() if v is not None else None
 
-    def result_cache_put(self, fingerprint: str, completed) -> bool:
+    # -- incremental execution (ISSUE 19) -------------------------------------
+    def save_job_facts(
+        self, job_id: str, content_key: str, facts: List[str]
+    ) -> None:
+        """The plan's content key + the scan-file facts its result_key was
+        built over, recorded at submission so the completion-time cache put
+        can stamp them onto the entry — the identity a LATER submission's
+        advancement probe matches against."""
+        body = "\n".join([content_key] + list(facts))
+        self.kv.put(self._key("jobfacts", job_id), body.encode())
+
+    def get_job_facts(self, job_id: str) -> Optional[Tuple[str, List[str]]]:
+        v = self.kv.get(self._key("jobfacts", job_id))
+        if v is None:
+            return None
+        lines = v.decode().split("\n")
+        return lines[0], lines[1:]
+
+    def result_cache_put(
+        self, fingerprint: str, completed, job_id: Optional[str] = None
+    ) -> bool:
         """Best-effort publish of a completed job's result partition
         locations under resultcache/{fingerprint}. The write passes the
         `cache.put` chaos site (keyed on the content-derived fingerprint —
@@ -780,6 +806,14 @@ class SchedulerState:
         )
         for pl in completed.partition_location:
             entry.partition_location.add().CopyFrom(pl)
+        if job_id is not None:
+            # advancement identity (ISSUE 19): stamp the content key + the
+            # scan-file facts recorded at submission, so a later submission
+            # over a GROWN file set can find this entry as its fold base
+            jf = self.get_job_facts(job_id)
+            if jf is not None:
+                entry.content_key = jf[0]
+                entry.scan_fact.extend(jf[1])
         try:
             if self._chaos is not None:
                 self._chaos.maybe_fail("cache.put", f"fp:{fingerprint[:16]}")
@@ -904,6 +938,17 @@ class SchedulerState:
             log.info("result-cache entry %s... expired (ttl %.0fs)",
                      fingerprint[:16], self.config.result_cache_ttl_s())
             return None
+        # advanced entries (ISSUE 19) are self-contained: the folded
+        # aggregate state rides the KV value itself, so no executor lease
+        # (or storage mount) gates serving them
+        if entry.state_ipc:
+            completed = pb.CompletedJob(
+                cached=True, inline_result=entry.state_ipc
+            )
+            entry.last_hit = time.time()
+            self.kv.put(key, entry.SerializeToString())
+            _record_tenancy("cache_hit")
+            return completed
         # storage-homed locations (ISSUE 15) outlive their producer: only
         # locations whose pieces live in an executor work dir need the
         # owner's lease alive for the entry to stay servable
@@ -933,6 +978,85 @@ class SchedulerState:
     def result_cache_invalidate(self, fingerprint: str) -> None:
         self._result_cache_delete(fingerprint)
         _record_tenancy("cache_invalidated")
+
+    # -- result-cache advancement (ISSUE 19) ----------------------------------
+    def result_cache_probe_advance(self, content_key: str, facts: List[str]):
+        """Best advancement base for a submission whose result_key missed:
+        a live same-content entry whose scan-fact set is a strict subset
+        of `facts` (the file set GREW — a moved base-file identity
+        disqualifies). Among candidates the one covering the most files
+        wins (smallest delta). Returns the ResultCacheEntry or None.
+
+        O(entries ≤ max_entries) scan — it runs only on a result-cache
+        MISS with advancement enabled, never on the hit path."""
+        from ballista_tpu.scheduler.delta import new_scan_files
+
+        best = None
+        best_n = -1
+        for k, v in self.kv.get_prefix(self._key("resultcache") + "/"):
+            e = pb.ResultCacheEntry()
+            try:
+                e.ParseFromString(v)
+            except Exception:
+                continue
+            if e.content_key != content_key or not e.scan_fact:
+                continue
+            if self._result_cache_expired(e):
+                continue
+            if new_scan_files(facts, list(e.scan_fact)) is None:
+                continue
+            # same liveness rule as lookup: an entry whose work-dir-homed
+            # pieces lost their executor cannot be fetched as a fold base
+            # (state-carrying entries are self-contained)
+            if not e.state_ipc and any(
+                self.get_executor_metadata(pl.executor_meta.id) is None
+                for pl in e.partition_location
+                if not pl.storage_uri
+            ):
+                continue
+            if len(e.scan_fact) > best_n:
+                best, best_n = e, len(e.scan_fact)
+        return best
+
+    def result_cache_put_advanced(
+        self,
+        result_key: str,
+        content_key: str,
+        facts: List[str],
+        state_ipc: bytes,
+        base_epoch: int,
+    ) -> bool:
+        """Publish an ADVANCED entry: the folded aggregate state inline
+        under the grown file set's result_key. Passes the `cache.advance`
+        chaos site — a torn publish is recorded and declined (the caller
+        falls back to a full recompute), never retried here and never
+        half-written: like cache.put, the site fires before any KV write."""
+        from ballista_tpu.utils.chaos import ChaosInjected
+
+        entry = pb.ResultCacheEntry(
+            fingerprint=result_key,
+            created_at=time.time(),
+            content_key=content_key,
+            state_ipc=state_ipc,
+            advance_epoch=base_epoch + 1,
+        )
+        entry.scan_fact.extend(facts)
+        try:
+            if self._chaos is not None:
+                self._chaos.maybe_fail("cache.advance", f"fp:{result_key[:16]}")
+            self._result_cache_evict_for(result_key)
+            key = self._key("resultcache", result_key)
+            prior = self.kv.get(key)
+            self.kv.put(key, entry.SerializeToString())
+            if prior is not None:
+                self._gc_cached_result(prior)
+        except ChaosInjected:
+            _record_recovery("chaos_injected")
+            log.warning("result-cache advancement torn by chaos (fp=%s...)",
+                        result_key[:16])
+            return False
+        _record_tenancy("cache_put")
+        return True
 
     # -- shared-store GC (ISSUE 16 satellite) -------------------------------
     @staticmethod
@@ -2758,4 +2882,4 @@ class SchedulerState:
             # AND caching was enabled for it — so this is already gated.
             fp = self.get_job_fingerprint(job_id)
             if fp is not None:
-                self.result_cache_put(fp, status.completed)
+                self.result_cache_put(fp, status.completed, job_id=job_id)

@@ -102,6 +102,15 @@ SITES = (
                              # bit-identical by construction and with ZERO
                              # task retries (nothing failed, only a cache
                              # went cold).
+    "cache.advance",         # result-cache advancement publish (ISSUE 19,
+                             # scheduler/state.py result_cache_put_advanced).
+                             # Fires BEFORE any KV write, keyed on the
+                             # advanced entry's result_key: a torn publish
+                             # declines the advancement — the user job falls
+                             # back to a FULL recompute through the ordinary
+                             # planning path, so results stay bit-identical
+                             # by construction (the fold is an accelerator,
+                             # never the only correct path).
     "task.slow",             # deterministic straggler injection (ISSUE 11,
                              # execution_loop.py): a task whose (stage,
                              # partition, attempt) coordinate draws a slow

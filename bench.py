@@ -1603,6 +1603,200 @@ def _exchange_scenario() -> dict | None:
     return result
 
 
+def _delta_scenario() -> dict | None:
+    """Incremental-execution scenario (ISSUE 19): a cached aggregation over
+    a growing parquet chunk set, run four ways —
+
+    - chunk reuse (advance off): an in-process engine with the persisted
+      layout store re-runs the query after a file append and must RELOAD
+      every existing chunk's tiles (chunks_reused >= 1) instead of
+      re-preparing the whole set;
+    - advancement: a standalone cluster with ballista.cache.advance on
+      folds delta partials over only the appended file into the cached
+      aggregate state (advance_hits >= 1) — strictly faster than a cold
+      full run over the grown set, and bit-identical to it;
+    - torn publish: the same append under seeded cache.advance chaos at
+      rate 1.0 declines the advancement and falls back to a full
+      recompute — still bit-identical, zero wrong answers;
+    - restart: the advanced entry (state inline in a durable KV) keeps
+      serving as a plain cache hit across a scheduler restart.
+
+    Knobs: BENCH_DELTA_ROWS (rows per file, default 50000),
+    BENCH_DELTA_SEED (chaos seed, default 19)."""
+    import hashlib
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.engine import ExecutionContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops import kernels
+    from ballista_tpu.ops.runtime import (
+        delta_stats,
+        release_stage_residency,
+        reset_residency,
+        tenancy_stats,
+    )
+    from ballista_tpu.scheduler.kv import SqliteBackend
+
+    n_rows = int(os.environ.get("BENCH_DELTA_ROWS", "50000"))
+    chaos_seed = int(os.environ.get("BENCH_DELTA_SEED", "19"))
+    sql = ("select g, sum(v) as sv, count(*) as c, min(v) as mn "
+           "from t where w > -5 group by g order by g")
+
+    def write_part(d, i):
+        rng = np.random.default_rng(190 + i)
+        pq.write_table(pa.table({
+            "g": pa.array(rng.integers(0, 7, n_rows), type=pa.int64()),
+            "v": pa.array(rng.integers(-50, 50, n_rows), type=pa.int64()),
+            "w": pa.array(rng.integers(-10, 10, n_rows), type=pa.int64()),
+        }), os.path.join(d, f"part-{i}.parquet"))
+
+    def digest(tbl):
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, tbl.schema) as w:
+            w.write_table(tbl)
+        return hashlib.sha256(sink.getvalue().to_pybytes()).hexdigest()[:16]
+
+    def reset_stage_caches():
+        # fresh-process simulation: the chunk-reuse leg must reload tiles
+        # from the persisted store, not from this process's stage cache
+        for stage in kernels._stage_cache.values():
+            if stage not in (None, False):
+                release_stage_residency(stage)
+        kernels._stage_cache.clear()
+        kernels._stage_cache_pins.clear()
+        kernels._stage_latest.clear()
+        reset_residency()
+
+    # -- leg 1: chunk reuse through the persisted layout store --------------
+    with tempfile.TemporaryDirectory() as d, \
+            tempfile.TemporaryDirectory() as cache_dir:
+        write_part(d, 0)
+        write_part(d, 1)
+
+        def engine_run():
+            ctx = ExecutionContext(BallistaConfig({
+                "ballista.executor.backend": "tpu",
+                "ballista.tpu.layout_cache_dir": cache_dir,
+                "ballista.batch.size": "4096",
+            }))
+            ctx.register_parquet("t", d)
+            return ctx.sql(sql).collect()
+
+        delta_stats(reset=True)
+        engine_run()
+        write_part(d, 2)
+        reset_stage_caches()
+        engine_run()
+        chunk_stats = delta_stats(reset=True)
+        reset_stage_caches()
+
+    def cluster_run(d, cluster, settings=None):
+        ctx = BallistaContext(*cluster.scheduler_addr, settings={
+            "ballista.cache.advance": "true",
+            **(settings or {}),
+        })
+        ctx.register_parquet("t", d)
+        t0 = time.perf_counter()
+        out = ctx.sql(sql).collect()
+        dt = time.perf_counter() - t0
+        ctx.close()
+        return out, dt
+
+    # -- leg 2: advancement vs cold full run --------------------------------
+    with tempfile.TemporaryDirectory() as d:
+        write_part(d, 0)
+        write_part(d, 1)
+        cluster = StandaloneCluster(n_executors=2)
+        try:
+            delta_stats(reset=True)
+            cluster_run(d, cluster)
+            write_part(d, 2)
+            adv_out, adv_dt = cluster_run(d, cluster)
+            adv_stats = delta_stats(reset=True)
+            cold_out, cold_dt = cluster_run(
+                d, cluster, settings={"ballista.cache.results": "false"})
+            cold_dt = min(cold_dt, cluster_run(
+                d, cluster,
+                settings={"ballista.cache.results": "false"})[1])
+        finally:
+            cluster.shutdown()
+
+    # -- leg 3: torn publish under cache.advance chaos ----------------------
+    with tempfile.TemporaryDirectory() as d:
+        write_part(d, 0)
+        write_part(d, 1)
+        chaos_cfg = BallistaConfig({
+            "ballista.chaos.seed": str(chaos_seed),
+            "ballista.chaos.rate": "1.0",
+            "ballista.chaos.sites": "cache.advance",
+        })
+        cluster = StandaloneCluster(n_executors=2, config=chaos_cfg)
+        try:
+            delta_stats(reset=True)
+            cluster_run(d, cluster)
+            write_part(d, 2)
+            chaos_out, _ = cluster_run(d, cluster)
+            chaos_stats = delta_stats(reset=True)
+        finally:
+            cluster.shutdown()
+
+    # -- leg 4: advanced entry across a scheduler restart -------------------
+    with tempfile.TemporaryDirectory() as d:
+        write_part(d, 0)
+        write_part(d, 1)
+        kv = SqliteBackend.temporary()
+        cluster = StandaloneCluster(n_executors=1, kv=kv)
+        try:
+            delta_stats(reset=True)
+            cluster_run(d, cluster)
+            write_part(d, 2)
+            cluster_run(d, cluster)
+            restart_advanced = delta_stats(reset=True).get(
+                "advance_hits", 0) >= 1
+            cluster.restart_scheduler()
+            tenancy_stats(reset=True)
+            restart_out, _ = cluster_run(d, cluster)
+            restart_hit = tenancy_stats(reset=True).get("cache_hit", 0) >= 1
+        finally:
+            cluster.shutdown()
+
+    bit_identical = (adv_out.equals(cold_out)
+                     and chaos_out.equals(cold_out)
+                     and restart_out.equals(cold_out))
+    result = {
+        "rows_per_file": n_rows,
+        "digest": digest(cold_out),
+        "bit_identical": bit_identical,
+        "advance_ms": round(adv_dt * 1000, 1),
+        "cold_ms": round(cold_dt * 1000, 1),
+        "speedup": round(cold_dt / adv_dt, 2) if adv_dt else None,
+        "chunks_reused": int(chunk_stats.get("chunks_reused", 0)),
+        "chunks_prepared": int(chunk_stats.get("chunks_prepared", 0)),
+        "bytes_reprepared_saved": int(
+            chunk_stats.get("bytes_reprepared_saved", 0)),
+        "advance_hits": int(adv_stats.get("advance_hits", 0)),
+        "advance_declined": int(adv_stats.get("advance_declined", 0)),
+        "chaos": {
+            "advance_hits": int(chaos_stats.get("advance_hits", 0)),
+            "advance_declined": int(chaos_stats.get("advance_declined", 0)),
+        },
+        "restart_advanced": restart_advanced,
+        "restart_cache_hit": restart_hit,
+    }
+    print(f"[delta] advance_ms={result['advance_ms']} "
+          f"cold_ms={result['cold_ms']} "
+          f"chunks_reused={result['chunks_reused']} "
+          f"advance_hits={result['advance_hits']} "
+          f"bit_identical={bit_identical}", file=sys.stderr)
+    return result
+
+
 def _routing_scenario() -> dict | None:
     """Adaptive-execution smoke (ISSUE 10): an in-process skewed join whose
     build-key multiplicity sits past the static admission ladder, run cold,
@@ -1701,6 +1895,10 @@ def main() -> None:
     if os.environ.get("BENCH_EXCHANGE_ONLY"):
         # HBM-resident exchange scenario only: runs without a reachable device
         print(json.dumps({"exchange": _exchange_scenario()}))
+        return
+    if os.environ.get("BENCH_DELTA_ONLY"):
+        # incremental-execution scenario only: runs without a reachable device
+        print(json.dumps({"delta": _delta_scenario()}))
         return
     _probe_device()
     ensure_data(SF)

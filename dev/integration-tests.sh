@@ -417,6 +417,35 @@ print("exchange smoke OK:",
        "digest": rec["digest"]})
 PY
 
+# incremental-execution bench smoke (ISSUE 19): appending a file to a
+# cached query's chunk set must (a) reload every existing chunk's tiles
+# from the persisted layout store, (b) serve the new result by FOLDING
+# delta partials into the cached aggregate state — strictly faster than a
+# cold full run over the grown set and bit-identical to it, (c) decline
+# to a full recompute when every advanced publish is torn by seeded
+# cache.advance chaos, and (d) keep serving the advanced entry as a plain
+# cache hit across a scheduler restart on a durable KV.
+JAX_PLATFORMS=cpu BENCH_DELTA_ONLY=1 python bench.py \
+    > /tmp/_ballista_delta_smoke.json
+python - /tmp/_ballista_delta_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["delta"]
+assert rec is not None, "delta scenario returned no record"
+assert rec["bit_identical"], "incremental execution changed results"
+assert rec["chunks_reused"] >= 1, rec
+assert rec["advance_hits"] >= 1, rec
+assert rec["advance_ms"] < rec["cold_ms"], (
+    f"advancement not faster than cold: {rec}")
+ch = rec["chaos"]
+assert ch["advance_hits"] == 0, "torn publish still served an advance"
+assert ch["advance_declined"] >= 1, ch
+assert rec["restart_advanced"] and rec["restart_cache_hit"], rec
+print("delta smoke OK:",
+      {"advance_ms": rec["advance_ms"], "cold_ms": rec["cold_ms"],
+       "chunks_reused": rec["chunks_reused"],
+       "advance_hits": rec["advance_hits"], "digest": rec["digest"]})
+PY
+
 # full tier-1 under the dynamic lock witness (ISSUE 16 satellite): every
 # fast test — the exchange registry, scheduler GC, chaos ladders, SPMD
 # admission included — runs with each project lock asserting the declared

@@ -25,7 +25,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-STR, U32, MSG, BOOL = 9, 13, 11, 8  # FieldDescriptorProto.Type
+STR, U32, MSG, BOOL, BYTES = 9, 13, 11, 8, 12  # FieldDescriptorProto.Type
 OPT, REP = 1, 3  # FieldDescriptorProto.Label
 
 _HEADER = '''# -*- coding: utf-8 -*-
@@ -342,6 +342,41 @@ def edit_issue16_resident_exchange(fdp) -> None:
     add_field(msgs["PartitionLocation"], "resident", 6, BOOL)
 
 
+def edit_issue19_delta(fdp) -> None:
+    """ISSUE 19: incremental execution (result-cache advancement).
+
+    Adds (all wire-compatible field additions):
+    - TaskDefinition.delta_for: non-empty on tasks of an internal delta
+      job — the user job id whose cached result the delta's output
+      advances. Provenance only: executors run the task like any other;
+      logs and telemetry can attribute the work to the advancement.
+    - CompletedJob.inline_result: the job's final result as one Arrow IPC
+      stream, served when the result cache holds advanced (folded)
+      aggregate state instead of executor-homed partition locations.
+      Clients must check it BEFORE treating an empty location list as an
+      empty result.
+    - ResultCacheEntry.content_key: the plan's content identity (the
+      result_key minus file facts) — the advancement probe matches
+      same-content entries whose file set the new submission grew.
+    - ResultCacheEntry.scan_fact: the (path|mtime|size) fact of every
+      scan file the entry's result covers, so the probe can check the
+      strict-superset relation fact-by-fact.
+    - ResultCacheEntry.state_ipc: resumable aggregate state (Arrow IPC)
+      for advanced entries; self-contained, so their liveness no longer
+      depends on any executor lease.
+    - ResultCacheEntry.advance_epoch: how many advancements produced this
+      entry (0 = cold run) — observability + fold-chain depth in logs.
+    """
+    msgs = {m.name: m for m in fdp.message_type}
+    add_field(msgs["TaskDefinition"], "delta_for", 7, STR)
+    add_field(msgs["CompletedJob"], "inline_result", 3, BYTES)
+    rc = msgs["ResultCacheEntry"]
+    add_field(rc, "content_key", 5, STR)
+    add_field(rc, "scan_fact", 6, STR, label=REP)
+    add_field(rc, "state_ipc", 7, BYTES)
+    add_field(rc, "advance_epoch", 8, U32)
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
@@ -353,6 +388,7 @@ APPLIED = [
     edit_issue13_shared_scan,
     edit_issue15_disaggregated_shuffle,
     edit_issue16_resident_exchange,
+    edit_issue19_delta,
 ]
 
 

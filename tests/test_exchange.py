@@ -155,6 +155,73 @@ def test_republish_newest_attempt_wins():
     assert exchange.resident_bytes() == b1.nbytes
 
 
+def test_tenant_budget_enforced_before_global(cm):
+    """ISSUE 19 satellite: a tenant at its residency cap evicts ITS OWN
+    LRU entries (cost-gated, like the global policy) and can never
+    displace another tenant's bytes to fit itself — the per-tenant ledger
+    follows every publish and drop."""
+    a1, a2, b1 = _batch(50), _batch(50), _batch(50)
+    budget = 1 << 20  # the global cap never binds in this test
+    t_budget = a1.nbytes + 8  # one piece per tenant fits, two do not
+    assert exchange.publish("e1", "j", 1, 0, 0, [a1], a1.schema, 0, "/a1",
+                            budget, tenant="alice", tenant_budget=t_budget)
+    assert exchange.publish("e1", "j", 1, 1, 0, [b1], b1.schema, 0, "/b1",
+                            budget, tenant="bob", tenant_budget=t_budget)
+    assert exchange.tenant_resident_bytes("alice") == a1.nbytes
+    assert exchange.tenant_resident_bytes("bob") == b1.nbytes
+    # alice's second piece (equal saving): evicts HER LRU piece, not bob's
+    assert exchange.publish("e1", "j", 1, 2, 0, [a2], a2.schema, 0, "/a2",
+                            budget, tenant="alice", tenant_budget=t_budget)
+    assert exchange.resolve("e1", "j", 1, 0, 0) is None  # a1 evicted
+    assert exchange.resolve("e1", "j", 1, 1, 0) is not None  # bob intact
+    assert exchange.resolve("e1", "j", 1, 2, 0) is not None
+    assert exchange.tenant_resident_bytes("alice") == a2.nbytes
+    assert exchange.tenant_resident_bytes("bob") == b1.nbytes
+    s = exchange_stats(reset=True)
+    assert s.get("evicted_tenant_budget") == 1, s
+    assert not s.get("evicted_budget"), s
+
+
+def test_tenant_budget_cost_gate_keeps_warmer_own_entry(cm):
+    """Within one tenant the same cost gate applies: a smaller incomer
+    whose predicted saving trails its own bigger resident's is skipped
+    rather than evicting it."""
+    big, small = _batch(100), _batch(25)
+    t_budget = big.nbytes + 8
+    assert exchange.publish("e1", "j", 1, 0, 0, [big], big.schema, 0,
+                            "/big", 1 << 20,
+                            tenant="alice", tenant_budget=t_budget)
+    assert not exchange.publish("e1", "j", 1, 1, 0, [small], small.schema, 0,
+                                "/small", 1 << 20,
+                                tenant="alice", tenant_budget=t_budget)
+    assert exchange.resolve("e1", "j", 1, 0, 0) is not None
+    assert exchange.tenant_resident_bytes("alice") == big.nbytes
+    s = exchange_stats(reset=True)
+    assert s.get("skipped_budget") == 1, s
+    # a single piece bigger than the tenant cap is rejected outright
+    assert not exchange.publish("e1", "j", 1, 2, 0, [big], big.schema, 0,
+                                "/big2", 1 << 20,
+                                tenant="bob", tenant_budget=big.nbytes - 1)
+    assert exchange.tenant_resident_bytes("bob") == 0
+
+
+def test_tenant_budget_plumbed_from_job_settings():
+    """End-to-end: ballista.tenant.residency_budget_bytes rides the job's
+    settings into the executor's capture — an over-cap tenant's pieces
+    are skipped (ladder reads, correct result), an uncapped run keeps
+    registering."""
+    t = _sales()
+    capped_out, capped_stats, _ = _run_cluster(t, {
+        "ballista.tenant.name": "alice",
+        "ballista.tenant.residency_budget_bytes": "1",
+    })
+    plain_out, plain_stats, _ = _run_cluster(t, {})
+    assert capped_out.equals(plain_out)
+    assert capped_stats.get("published", 0) == 0, capped_stats
+    assert capped_stats.get("skipped_budget", 0) >= 1, capped_stats
+    assert plain_stats.get("published", 0) >= 1, plain_stats
+
+
 def test_evict_and_evict_job():
     b = _batch(4)
     exchange.publish("e1", "ja", 1, 0, 0, [b], b.schema, 0, "/pa", 1 << 20)

@@ -882,3 +882,122 @@ def test_fuzz_exchange_chaos(seed):
     # the ladder instead of failing the task
     assert ex.get("published", 0) > 0, ex
     assert ex.get("evicted_chaos", 0) >= 1, ex
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 19: incremental execution under randomized appends
+# ---------------------------------------------------------------------------
+
+
+def _delta_fuzz_queries(qrng):
+    """Randomized advancement-shaped aggregations plus one deliberately
+    INELIGIBLE member set (a float sum must decline, never mis-fold)."""
+    queries = []
+    for _ in range(3):
+        members = ["count(*) as c"]
+        if qrng.integers(0, 2):
+            members.append("sum(v) as sv")
+        if qrng.integers(0, 2):
+            members.append("min(v) as mn")
+        if qrng.integers(0, 2):
+            members.append("max(v) as mx")
+        keys = "g, h" if qrng.integers(0, 2) else "g"
+        thr = int(qrng.integers(-8, 2))
+        queries.append(
+            f"select {keys}, {', '.join(members)} from t where w > {thr} "
+            f"group by {keys} order by {keys}"
+        )
+    queries.append(
+        "select g, sum(f) as sf, count(*) as c from t "
+        "group by g order by g"
+    )
+    return queries
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fuzz_delta_append(tmp_path, seed):
+    """ROADMAP fuzzer slice (ISSUE 19): randomized eligible and ineligible
+    aggregations over a parquet set that GROWS mid-stream, with the result
+    cache advancing on the appends — fault-free and with every advanced
+    publish torn by cache.advance chaos. Every configuration must be
+    bit-identical to a cold full run over the grown set; the ineligible
+    member set (float sum) must decline, never mis-fold. Own rng streams
+    (28000+ data, 29000+ queries), so every baseline stream above stays
+    byte-identical."""
+    import os
+
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import delta_stats
+
+    rng = np.random.default_rng(28000 + seed)
+    qrng = np.random.default_rng(29000 + seed)
+    d = str(tmp_path / "grow")
+    os.makedirs(d)
+
+    def write_part(i):
+        n = int(rng.integers(1_000, 4_000))
+        pq.write_table(pa.table({
+            "g": pa.array(rng.integers(0, 9, n), type=pa.int64()),
+            "h": pa.array(rng.integers(0, 3, n), type=pa.int64()),
+            "v": pa.array(rng.integers(-100, 100, n), type=pa.int64()),
+            "w": pa.array(rng.integers(-10, 10, n), type=pa.int64()),
+            "f": pa.array(rng.random(n), type=pa.float64()),
+        }), os.path.join(d, f"part-{i}.parquet"))
+
+    write_part(0)
+    write_part(1)
+    queries = _delta_fuzz_queries(qrng)
+    next_part = [2]
+
+    def run_grow(cluster_config=None):
+        """Cold pass over the current set, append one NEW file (never a
+        rewrite — a moved identity is a correct probe miss, not a delta),
+        advanced pass over the grown set."""
+        cluster = StandaloneCluster(n_executors=2, config=cluster_config)
+        try:
+            ctx = BallistaContext(*cluster.scheduler_addr, settings={
+                "ballista.cache.advance": "true",
+            })
+            ctx.register_parquet("t", d)
+            for sql in queries:
+                ctx.sql(sql).collect()
+            write_part(next_part[0])
+            next_part[0] += 1
+            ctx.register_parquet("t", d)
+            grown = [ctx.sql(sql).collect() for sql in queries]
+            truth_ctx = BallistaContext(*cluster.scheduler_addr, settings={
+                "ballista.cache.results": "false",
+            })
+            truth_ctx.register_parquet("t", d)
+            truth = [truth_ctx.sql(sql).collect() for sql in queries]
+            ctx.close()
+            truth_ctx.close()
+            return grown, truth
+        finally:
+            cluster.shutdown()
+
+    delta_stats(reset=True)
+    grown, truth = run_grow()
+    stats = delta_stats(reset=True)
+    for sql, g, t in zip(queries, grown, truth):
+        assert g.equals(t), (sql, g.to_pydict(), t.to_pydict())
+    # the eligible shapes advanced; the float-sum shape declined loudly
+    assert stats.get("advance_hits", 0) >= 1, stats
+    assert stats.get("advance_declined", 0) >= 1, stats
+
+    # every advanced publish torn: all declines, still bit-identical. The
+    # chaos pass's cold queries hit the first pass's (shared content-key)
+    # cache entries; its append then forces a NEW advancement attempt
+    # whose publish the chaos site tears.
+    delta_stats(reset=True)
+    chaos_grown, chaos_truth = run_grow(BallistaConfig({
+        "ballista.chaos.rate": "1.0",
+        "ballista.chaos.seed": str(70 + seed),
+        "ballista.chaos.sites": "cache.advance",
+    }))
+    stats = delta_stats(reset=True)
+    for sql, g, t in zip(queries, chaos_grown, chaos_truth):
+        assert g.equals(t), (sql, g.to_pydict(), t.to_pydict())
+    assert stats.get("advance_hits", 0) == 0, stats
+    assert stats.get("advance_declined", 0) >= 1, stats

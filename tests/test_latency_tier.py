@@ -288,6 +288,55 @@ def test_aot_roundtrip_disk_hit_and_prewarm(tmp_path):
     np.testing.assert_array_equal(out1, out3)
 
 
+def test_warm_compiles_without_execute(tmp_path):
+    """ISSUE 19 satellite: ``step.warm(...)`` compiles (and persists) a
+    signature WITHOUT running the program — the later real call neither
+    traces nor compiles, and a cold process warms straight from the
+    persisted artifact."""
+    import jax.numpy as jnp
+
+    aotcache.reset(clear_disk_dir=True)
+    traces = {"n": 0}
+
+    def core(n, cols, aux):
+        traces["n"] += 1
+        return jnp.stack(
+            [jnp.sum(jnp.where(cols[0] == g, cols[1], 0.0)) for g in range(n)]
+        ) + aux[0]
+
+    aotcache.configure(
+        BallistaConfig({"ballista.tpu.aot_cache": str(tmp_path / "aot")})
+    )
+    step = aotcache.wrap_step(
+        _Owner("warm-A"), "unit", core, static_argnums=(0,)
+    )
+    serving_stats(reset=True)
+    assert step.warm(*_args()) is True
+    s = serving_stats(reset=True)
+    assert s.get("compile_warmed") == 1 and s.get("aot_saved") == 1
+    assert not s.get("compile_trace")
+    warm_traces = traces["n"]
+    assert warm_traces >= 1  # the warm itself traced (a compile happened)
+    # the real call: memory-map hit + jit executable-cache hit — NO retrace
+    out = np.asarray(step(*_args()))
+    s = serving_stats(reset=True)
+    assert s.get("compile_hit_memory") == 1 and not s.get("compile_trace")
+    assert traces["n"] == warm_traces  # compile-without-execute held: the
+    # signature was never traced again after the warm
+    # a second warm finds the signature already resolvable
+    assert step.warm(*_args()) is False
+    # cold process: the artifact the warm persisted serves a disk warm
+    aotcache.reset()
+    step2 = aotcache.wrap_step(
+        _Owner("warm-A"), "unit", core, static_argnums=(0,)
+    )
+    serving_stats(reset=True)
+    assert step2.warm(*_args()) is True
+    s = serving_stats(reset=True)
+    assert s.get("compile_hit_disk") == 1 and not s.get("compile_warmed")
+    np.testing.assert_array_equal(out, np.asarray(step2(*_args())))
+
+
 def test_aot_shape_and_stage_keyed(tmp_path):
     """A different shape bucket or a different stage identity is a
     different program — no false sharing."""

@@ -310,7 +310,9 @@ def test_batches_path_warm_start(tmp_path, monkeypatch):
     import json as _json
 
     metas = [_json.load(open(p)) for p in cache.rglob("meta.json")]
-    assert any(m.get("kind") == "batches" for m in metas), metas
+    # ISSUE 19: parquet-backed batches persist per-chunk delta entries
+    # (one per (path, mtime, size, chunk_index)), not one whole-set blob
+    assert any(m.get("kind") == "chunk" for m in metas), metas
     _reset_stage_caches()
 
     real_read = pq.read_table

@@ -297,6 +297,25 @@ def test_failure_rule_exchange_site_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_delta_site_fixture_pair():
+    """ISSUE 19: the cache.advance site is registered — an unregistered
+    advancement site and a computed cache site name fail lint; the
+    registered-literal shape (result-key-keyed verdict BEFORE any KV
+    write of the advanced entry) is clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_delta_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "cache.fold" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_delta_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_routing_rule_fixture_pair():
     """ISSUE 10 satellite: a decline-helper call with no routing
     observation in scope and no cold-path annotation fails lint — a
