@@ -131,6 +131,7 @@ class _JobStatusSource:
 
     def __init__(self, client, config, job_id: str) -> None:
         self._client = client
+        self._config = config
         self._job_id = job_id
         self._watch = (
             _StatusWatch(client, job_id) if config.push_status() else None
@@ -155,9 +156,22 @@ class _JobStatusSource:
             time.sleep(self._interval)
             self._interval = min(self._interval * 2, POLL_INTERVAL)
         self._polled = True
-        return self._client.get_job_status(
+        res = self._client.get_job_status(
             pb.GetJobStatusParams(job_id=self._job_id)
-        ).status
+        )
+        # ownership redirect (ISSUE 20): the polled replica named the
+        # job's owner. Status POLLS answer from any replica (shared KV
+        # truth), but the push stream only fires on the owner — jump the
+        # client there and re-home the subscription once per switch.
+        if res.owner_addr and self._client.prefer_endpoint(res.owner_addr):
+            if self._watch is not None:
+                self._watch.close()
+            if self._config.push_status():
+                from ballista_tpu.ops.runtime import record_serving
+
+                record_serving("status_push_rehomed")
+                self._watch = _StatusWatch(self._client, self._job_id)
+        return res.status
 
     def close(self) -> None:
         if self._watch is not None:
@@ -172,15 +186,20 @@ class BallistaContext(ExecutionContext):
         host: str = "localhost",
         port: int = 50050,
         settings: Optional[Dict[str, str]] = None,
+        endpoints: Optional[Sequence] = None,
     ) -> None:
         super().__init__(BallistaConfig(settings))
         self.host = host
         self.port = port
+        # `endpoints` adds failover scheduler replicas (ISSUE 20): submit,
+        # poll and subscribe work against ANY of them — transient failures
+        # and ownership redirects rotate the client automatically
         self._client = SchedulerGrpcClient(
             host,
             port,
             retries=self.config.rpc_retries(),
             backoff_s=self.config.rpc_backoff_s(),
+            endpoints=endpoints,
         )
 
     @classmethod

@@ -280,6 +280,17 @@ BALLISTA_TPU_COST_MODEL_DIR = "ballista.tpu.cost_model_dir"
 # enabling is process-global and sticky. Env equivalents:
 # BALLISTA_LOCK_WITNESS=1 / BALLISTA_LOCK_WITNESS_OUT=<path>.
 BALLISTA_DEBUG_LOCK_WITNESS = "ballista.debug.lock_witness"
+# -- replicated control plane (ISSUE 20) ------------------------------------
+# TTL of the per-job ownership lease (leases/{job}) a scheduler replica
+# mints with the planning commit and renews from its heartbeat thread at
+# ttl/3. Expiry is the failover trigger: an idle peer adopts the dead
+# replica's jobs by running restart recovery scoped to them, so this bounds
+# the ownership-migration latency after a replica dies. Fencing (the CAS on
+# the lease value in every owner write) makes a TOO-short TTL safe — a
+# spurious expiry costs a migration, never corruption — but each migration
+# re-runs scoped recovery, so production deployments want seconds, not
+# milliseconds.
+BALLISTA_SCHEDULER_LEASE_TTL_S = "ballista.scheduler.lease_ttl_s"
 # -- deterministic fault injection (utils/chaos.py) -------------------------
 # rate > 0 arms the registered injection sites; each (site, key) pair draws
 # a DETERMINISTIC verdict from sha256(seed, site, key), so a chaos run is
@@ -376,6 +387,7 @@ DEFAULT_SETTINGS: Dict[str, str] = {
     BALLISTA_TPU_COST_MODEL_DIR: ".ballista_cache/costmodel",
     BALLISTA_RPC_RETRIES: "3",
     BALLISTA_RPC_BACKOFF_MS: "50",
+    BALLISTA_SCHEDULER_LEASE_TTL_S: "5",
     BALLISTA_DEBUG_LOCK_WITNESS: "false",
     BALLISTA_CHAOS_SEED: "0",
     BALLISTA_CHAOS_RATE: "0",
@@ -708,6 +720,15 @@ class BallistaConfig(Mapping[str, str]):
     def rpc_backoff_s(self) -> float:
         """Jittered-exponential backoff base, in seconds."""
         return max(0.0, float(self._settings[BALLISTA_RPC_BACKOFF_MS])) / 1000.0
+
+    def scheduler_lease_ttl_s(self) -> float:
+        """Job-ownership lease TTL (ISSUE 20); the failover detection bound."""
+        ttl = float(self._settings[BALLISTA_SCHEDULER_LEASE_TTL_S])
+        if ttl <= 0:
+            raise ValueError(
+                f"ballista.scheduler.lease_ttl_s must be > 0, got {ttl}"
+            )
+        return ttl
 
     def debug_lock_witness(self) -> bool:
         # ISSUE 14: arm the dynamic lock-order witness (utils/locks.py)

@@ -48,6 +48,7 @@ class BallistaExecutor:
         concurrent_tasks: int = 4,
         config: Optional[BallistaConfig] = None,
         executor_id: Optional[str] = None,
+        scheduler_endpoints: Optional[List[Tuple[str, int]]] = None,
     ) -> None:
         self.id = executor_id or str(uuid.uuid4())
         self.host = external_host
@@ -60,12 +61,17 @@ class BallistaExecutor:
         self._flight_thread = threading.Thread(target=self.flight.serve, daemon=True)
         from ballista_tpu.utils.chaos import chaos_from_config
 
+        # replicated control plane (ISSUE 20): the extra endpoints let the
+        # client rotate to a peer replica when its home scheduler dies —
+        # failed polls rotate, and the ownership-redirect abort names the
+        # new owner so re-homing converges in one hop
         self.scheduler_client = SchedulerGrpcClient(
             scheduler_host,
             scheduler_port,
             retries=self.config.rpc_retries(),
             backoff_s=self.config.rpc_backoff_s(),
             chaos=chaos_from_config(self.config),
+            endpoints=scheduler_endpoints,
         )
         meta = pb.ExecutorMetadata(id=self.id, host=self.host, port=self.port)
         self.poll_loop = PollLoop(
@@ -126,15 +132,35 @@ class StandaloneCluster:
         kv: Optional[KvBackend] = None,
         config: Optional[BallistaConfig] = None,
         concurrent_tasks: int = 4,
+        n_schedulers: int = 1,
     ) -> None:
         from ballista_tpu.utils.chaos import chaos_from_config
         from ballista_tpu.utils.locks import make_lock
 
         self.config = config or BallistaConfig()
         self.kv = kv or MemoryBackend()
-        self.scheduler_impl = SchedulerServer(self.kv, config=self.config)
-        self.port = _free_port()
-        self.grpc_server = serve(self.scheduler_impl, "127.0.0.1", self.port)
+        # replicated control plane (ISSUE 20): n_schedulers > 1 runs peer
+        # SchedulerServer replicas over the SAME KV store, each with a
+        # stable replica id and an advertised address (the ownership hint
+        # clients/executors re-home on). n_schedulers == 1 keeps the legacy
+        # anonymous single scheduler (replica_id "" — a restart reclaims
+        # its predecessor's leases instead of adopting them as a peer).
+        self.n_schedulers = max(1, n_schedulers)
+        self.scheduler_impls: List[SchedulerServer] = []
+        self.ports: List[int] = []
+        self.grpc_servers: List[grpc.Server] = []
+        for i in range(self.n_schedulers):
+            port = _free_port()
+            replica_id = f"replica-{i}" if self.n_schedulers > 1 else ""
+            impl = SchedulerServer(
+                self.kv,
+                config=self.config,
+                replica_id=replica_id,
+                advertise_addr=f"127.0.0.1:{port}" if replica_id else "",
+            )
+            self.scheduler_impls.append(impl)
+            self.ports.append(port)
+            self.grpc_servers.append(serve(impl, "127.0.0.1", port))
         self._concurrent_tasks = concurrent_tasks
         # fleet membership: mutated by the autoscaler thread, read by
         # shutdown/tests. Executors are constructed and started OUTSIDE
@@ -165,12 +191,20 @@ class StandaloneCluster:
         with self._fleet_mu:
             idx = self._next_executor_idx
             self._next_executor_idx += 1
+        # round-robin home replica; the full (rotated) endpoint list rides
+        # along so a dead home rotates to a live peer instead of stranding
+        home = idx % self.n_schedulers
+        endpoints = [
+            ("127.0.0.1", self.ports[(home + k) % self.n_schedulers])
+            for k in range(self.n_schedulers)
+        ]
         ex = BallistaExecutor(
             "127.0.0.1",
-            self.port,
+            self.ports[home],
             config=self.config,
             concurrent_tasks=self._concurrent_tasks,
             executor_id=f"local-{idx}",
+            scheduler_endpoints=endpoints,
         )
         ex.start()
         with self._fleet_mu:
@@ -289,11 +323,47 @@ class StandaloneCluster:
         log.info("fleet scaled in: retired %s (%d -> %d)", ex.id, size, size2)
         return True
 
+    # -- single-scheduler compat surface (replica 0) -------------------
+    @property
+    def scheduler_impl(self) -> SchedulerServer:
+        return self.scheduler_impls[0]
+
+    @property
+    def port(self) -> int:
+        return self.ports[0]
+
+    @property
+    def grpc_server(self) -> grpc.Server:
+        return self.grpc_servers[0]
+
     @property
     def scheduler_addr(self) -> Tuple[str, int]:
         return ("127.0.0.1", self.port)
 
-    def restart_scheduler(self) -> SchedulerServer:
+    @property
+    def scheduler_addrs(self) -> List[str]:
+        return [f"127.0.0.1:{p}" for p in self.ports]
+
+    @property
+    def scheduler_endpoints(self) -> List[Tuple[str, int]]:
+        return [("127.0.0.1", p) for p in self.ports]
+
+    def kill_scheduler(self, i: int) -> SchedulerServer:
+        """Kill replica `i` PERMANENTLY (ISSUE 20 failover): fence its
+        in-flight work, tear down its push streams and listening socket,
+        and do NOT restart it. Its `leases/{job}` entries stop renewing;
+        within one lease TTL an idle peer's housekeeping scan adopts the
+        orphaned jobs via a scoped recovery run, and the dead replica's
+        executors rotate to peer endpoints on their next failed poll."""
+        impl = self.scheduler_impls[i]
+        impl.crashed = True
+        impl.stop_housekeeping()
+        impl.close_push_streams()
+        self.grpc_servers[i].stop(grace=None).wait()
+        log.info("killed scheduler replica %d (%s)", i, impl.state.replica_id)
+        return impl
+
+    def restart_scheduler(self, i: int = 0) -> SchedulerServer:
         """Simulate scheduler process death + restart on the same KV store
         (ISSUE 6): stop the gRPC server, build a FRESH SchedulerServer over
         the same backend (its __init__ runs restart recovery — torn-job
@@ -301,24 +371,32 @@ class StandaloneCluster:
         executors and clients ride their transient-UNAVAILABLE retry loops
         across the gap. All in-memory scheduler state (task index, ledger
         timestamps, planning threads) dies with the old instance — exactly
-        what a real restart loses."""
-        old = self.scheduler_impl
+        what a real restart loses. The successor keeps the predecessor's
+        replica identity, so it reclaims (not adopts) its own leases."""
+        old = self.scheduler_impls[i]
         # fence the old instance FIRST: its still-running planning threads
         # must not publish into the store the successor is recovering
         old.crashed = True
+        old.stop_housekeeping()
         # unblock the push-stream generators NOW (sentinel close) so the
         # stop below drains without waiting out their 0.25s tick — the gap
         # must stay inside retrying clients' backoff budget
         old.close_push_streams()
         # wait for the listening socket to actually close before rebinding
         # the same port (so_reuseport is not guaranteed everywhere)
-        self.grpc_server.stop(grace=None).wait()
-        self.scheduler_impl = SchedulerServer(self.kv, config=self.config)
+        self.grpc_servers[i].stop(grace=None).wait()
+        fresh = SchedulerServer(
+            self.kv,
+            config=self.config,
+            replica_id=old.state.replica_id,
+            advertise_addr=old.state.replica_addr,
+        )
         # test harness tuning survives the restart (a redeployed scheduler
         # keeps its deployment config)
-        self.scheduler_impl.lost_task_check_interval = old.lost_task_check_interval
-        self.grpc_server = serve(self.scheduler_impl, "127.0.0.1", self.port)
-        return self.scheduler_impl
+        fresh.lost_task_check_interval = old.lost_task_check_interval
+        self.scheduler_impls[i] = fresh
+        self.grpc_servers[i] = serve(fresh, "127.0.0.1", self.ports[i])
+        return fresh
 
     def shutdown(self) -> None:
         self._fleet_stop.set()
@@ -329,5 +407,7 @@ class StandaloneCluster:
             executors = list(self.executors)
         for ex in executors:
             ex.stop()
-        self.scheduler_impl.close_push_streams()
-        self.grpc_server.stop(grace=None)
+        for impl, srv in zip(self.scheduler_impls, self.grpc_servers):
+            impl.stop_housekeeping()
+            impl.close_push_streams()
+            srv.stop(grace=None)

@@ -36,12 +36,44 @@ class KvBackend:
     def put(self, key: str, value: bytes, lease_seconds: Optional[float] = None) -> None:
         raise NotImplementedError
 
-    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
+    def put_all(
+        self,
+        items: List[Tuple[str, bytes]],
+        *,
+        compare: Optional[Tuple[str, Optional[bytes]]] = None,
+        leases: Optional[List[Tuple[str, bytes, float]]] = None,
+    ) -> bool:
         """Atomic multi-put: either every (key, value) lands or none does —
         the crash-safe publish seam for multi-key writes (a job's planning
         output must never be half-visible, ISSUE 6). Backends without real
         transactions must still make the batch all-or-nothing under the
-        global lock."""
+        global lock.
+
+        ISSUE 20 extensions for the replicated control plane:
+        - `compare=(key, expected)` turns the batch into a fenced
+          compare-and-swap: the batch lands only while `key`'s live value
+          equals `expected` (`expected=None` means the key must be ABSENT);
+          on mismatch nothing is written and the call returns False. This
+          is the fencing rule — a deposed job owner's remembered lease
+          value no longer matches, so its stale writes are rejected whole.
+        - `leases=[(key, value, ttl_seconds)]` rides TTL-carrying writes in
+          the same atomic batch (a job's ownership lease is minted with the
+          planning commit, never beside it).
+
+        Returns True when the batch landed."""
+        raise NotImplementedError
+
+    # -- lease primitives (ISSUE 20) ------------------------------------
+    def lease_grant(self, key: str, value: bytes, ttl_seconds: float) -> None:
+        """Write `key` with a TTL: invisible to get/scan after expiry
+        unless renewed. Equivalent to put(..., lease_seconds=ttl) on
+        embedded backends; etcd mints a real lease handle."""
+        self.put(key, value, lease_seconds=ttl_seconds)
+
+    def lease_renew(self, key: str, ttl_seconds: float) -> bool:
+        """Extend a live leased key's expiry, preserving its value. Returns
+        False when the key is missing or already expired — the caller has
+        been deposed and must NOT write as if it still held the lease."""
         raise NotImplementedError
 
     def delete(self, key: str) -> None:
@@ -93,12 +125,35 @@ class MemoryBackend(KvBackend):
             expires = time.time() + lease_seconds if lease_seconds else None
             self._data[key] = (value, expires)
 
-    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
+    def put_all(
+        self,
+        items: List[Tuple[str, bytes]],
+        *,
+        compare: Optional[Tuple[str, Optional[bytes]]] = None,
+        leases: Optional[List[Tuple[str, bytes, float]]] = None,
+    ) -> bool:
         # validate the whole batch before touching the dict so a bad item
         # cannot leave a partial write behind
         staged = [(k, (v, None)) for k, v in items]
+        for k, v, ttl in leases or ():
+            float(ttl)
         with self._mu:
+            if compare is not None and self._live(compare[0]) != compare[1]:
+                return False
+            now = time.time()
             self._data.update(staged)
+            self._data.update(
+                (k, (v, now + ttl)) for k, v, ttl in leases or ()
+            )
+            return True
+
+    def lease_renew(self, key: str, ttl_seconds: float) -> bool:
+        with self._mu:
+            value = self._live(key)
+            if value is None:
+                return False
+            self._data[key] = (value, time.time() + ttl_seconds)
+            return True
 
     def delete(self, key: str) -> None:
         with self._mu:
@@ -183,21 +238,61 @@ class SqliteBackend(KvBackend):
             )
             self._conn.commit()
 
-    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
+    def put_all(
+        self,
+        items: List[Tuple[str, bytes]],
+        *,
+        compare: Optional[Tuple[str, Optional[bytes]]] = None,
+        leases: Optional[List[Tuple[str, bytes, float]]] = None,
+    ) -> bool:
         # one sqlite transaction: a crash (or a bad item) mid-batch rolls
         # the whole publish back — this is the backend-transaction form of
-        # the ISSUE 6 all-or-nothing planning write
+        # the ISSUE 6 all-or-nothing planning write. The fenced compare
+        # reads under the same lock+transaction, so the check-then-write
+        # is atomic against every other writer of this store.
         with self._mu:
             try:
+                if compare is not None:
+                    ckey, expected = compare
+                    row = self._conn.execute(
+                        "SELECT value, expires FROM kv WHERE key = ?", (ckey,)
+                    ).fetchone()
+                    current = None
+                    if row is not None:
+                        value, exp = row
+                        if exp is None or time.time() <= exp:
+                            current = bytes(value)
+                    if current != expected:
+                        self._conn.rollback()
+                        return False
                 self._conn.executemany(
                     "INSERT OR REPLACE INTO kv (key, value, expires) "
                     "VALUES (?, ?, NULL)",
                     items,
                 )
+                if leases:
+                    now = time.time()
+                    self._conn.executemany(
+                        "INSERT OR REPLACE INTO kv (key, value, expires) "
+                        "VALUES (?, ?, ?)",
+                        [(k, v, now + ttl) for k, v, ttl in leases],
+                    )
                 self._conn.commit()
+                return True
             except BaseException:
                 self._conn.rollback()
                 raise
+
+    def lease_renew(self, key: str, ttl_seconds: float) -> bool:
+        with self._mu:
+            now = time.time()
+            cur = self._conn.execute(
+                "UPDATE kv SET expires = ? WHERE key = ? "
+                "AND (expires IS NULL OR expires > ?)",
+                (now + ttl_seconds, key, now),
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
 
     def delete(self, key: str) -> None:
         with self._mu:
@@ -234,6 +329,9 @@ class EtcdBackend(KvBackend):
         host, _, port = endpoints.partition(":")
         self._client = etcd3.client(host=host, port=int(port or 2379))
         self._lock_name = "/ballista_global_lock"
+        # lease handles this client granted, keyed by the key they guard:
+        # etcd renews through the handle (keepalive), not through the key
+        self._leases: Dict[str, object] = {}
 
     def get(self, key: str) -> Optional[bytes]:
         value, _ = self._client.get(key)
@@ -261,22 +359,68 @@ class EtcdBackend(KvBackend):
     # batch beyond it cannot be published atomically on a default server
     MAX_TXN_OPS = 128
 
-    def put_all(self, items: List[Tuple[str, bytes]]) -> None:
-        # etcd v3 transaction: success branch only, no compares — an
-        # unconditional atomic multi-put
-        if len(items) > self.MAX_TXN_OPS:
+    def put_all(
+        self,
+        items: List[Tuple[str, bytes]],
+        *,
+        compare: Optional[Tuple[str, Optional[bytes]]] = None,
+        leases: Optional[List[Tuple[str, bytes, float]]] = None,
+    ) -> bool:
+        # etcd v3 transaction; the fenced form compares the guard key's
+        # live VALUE (version==0 for expect-absent) in the same txn, which
+        # is exactly etcd's native compare-and-swap
+        import math
+
+        n = len(items) + len(leases or ())
+        if n > self.MAX_TXN_OPS:
             # fail LOUDLY instead of letting the server reject with an
             # opaque error (or silently splitting and losing atomicity):
             # the deployment must raise --max-txn-ops to plan jobs with
             # this many stages x partitions
             raise RuntimeError(
-                f"atomic batch of {len(items)} keys exceeds etcd's default "
+                f"atomic batch of {n} keys exceeds etcd's default "
                 f"max-txn-ops ({self.MAX_TXN_OPS}); raise --max-txn-ops on "
                 "the etcd server (and MAX_TXN_OPS here) or reduce "
                 "ballista.shuffle.partitions"
             )
+        compares = []
+        if compare is not None:
+            ckey, expected = compare
+            if expected is None:
+                compares = [self._client.transactions.version(ckey) == 0]
+            else:
+                compares = [self._client.transactions.value(ckey) == expected]
         ops = [self._client.transactions.put(k, v) for k, v in items]
-        self._client.transaction(compare=[], success=ops, failure=[])
+        for k, v, ttl in leases or ():
+            handle = self._client.lease(max(1, math.ceil(ttl)))
+            self._leases[k] = handle
+            ops.append(self._client.transactions.put(k, v, lease=handle))
+        ok, _responses = self._client.transaction(
+            compare=compares, success=ops, failure=[]
+        )
+        return bool(ok)
+
+    def lease_grant(self, key: str, value: bytes, ttl_seconds: float) -> None:
+        import math
+
+        handle = self._client.lease(max(1, math.ceil(ttl_seconds)))
+        self._leases[key] = handle
+        self._client.put(key, value, lease=handle)
+
+    def lease_renew(self, key: str, ttl_seconds: float) -> bool:
+        current = self.get(key)
+        if current is None:
+            # expired (or never ours): the handle, if any, is dead weight
+            self._leases.pop(key, None)
+            return False
+        handle = self._leases.get(key)
+        if handle is not None:
+            handle.refresh()
+            return True
+        # live key granted by another client (e.g. adopted after a peer
+        # died mid-TTL): re-grant under a fresh lease, preserving the value
+        self.lease_grant(key, current, ttl_seconds)
+        return True
 
     def delete(self, key: str) -> None:
         self._client.delete(key)

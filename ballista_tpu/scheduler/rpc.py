@@ -113,7 +113,14 @@ class SchedulerGrpcClient:
     Transient failures (UNAVAILABLE / connect errors — a scheduler restart,
     a network blip) are retried `retries` times with jittered exponential
     backoff; execution errors surface immediately. An armed chaos injector
-    (utils/chaos.py "rpc.call" site) exercises exactly this loop."""
+    (utils/chaos.py "rpc.call" site) exercises exactly this loop.
+
+    Replicated control plane (ISSUE 20): the client may hold a LIST of
+    scheduler endpoints. Calls go to the active endpoint; every transient
+    failure rotates to the next before retrying, so a dead replica (or an
+    ownership redirect, which the replicas answer as UNAVAILABLE naming
+    the owner) re-homes the caller within one retry loop. Channels are
+    built lazily per endpoint and all share one options/backoff config."""
 
     def __init__(
         self,
@@ -123,10 +130,20 @@ class SchedulerGrpcClient:
         retries: int = 3,
         backoff_s: float = 0.05,
         chaos=None,
+        endpoints=None,
     ) -> None:
-        self.channel = channel or grpc.insecure_channel(
-            f"{host}:{port}", options=GRPC_MESSAGE_OPTIONS
-        )
+        # (host, port) stays endpoint 0 for wire compat; `endpoints` adds
+        # failover peers in preference order (duplicates of endpoint 0 drop)
+        self.endpoints = [(host, int(port))]
+        for ep in endpoints or ():
+            ep = (ep[0], int(ep[1]))
+            if ep not in self.endpoints:
+                self.endpoints.append(ep)
+        self._channels: dict = {}
+        if channel is not None:
+            self._channels[0] = channel
+        self._active = 0
+        self._stub_cache: dict = {}
         self.retries = max(0, retries)
         self.backoff_s = backoff_s
         self.chaos = chaos
@@ -134,20 +151,76 @@ class SchedulerGrpcClient:
         # method -> call count
         # guarded-by: self._chaos_mu
         self._chaos_calls: dict = {}
-        self._stubs = {}
-        for name, (req_cls, resp_cls) in _METHODS.items():
-            self._stubs[name] = self.channel.unary_unary(
+
+    @property
+    def channel(self) -> grpc.Channel:
+        """The ACTIVE endpoint's channel (wire compat with single-endpoint
+        callers that reach in for it)."""
+        return self._channel(self._active)
+
+    def _channel(self, idx: int) -> grpc.Channel:
+        ch = self._channels.get(idx)
+        if ch is None:
+            h, p = self.endpoints[idx]
+            ch = grpc.insecure_channel(
+                f"{h}:{p}", options=GRPC_MESSAGE_OPTIONS
+            )
+            self._channels[idx] = ch
+        return ch
+
+    def _stub(self, name: str, stream: bool = False):
+        idx = self._active
+        key = (idx, name)
+        stub = self._stub_cache.get(key)
+        if stub is None:
+            factory = (
+                self._channel(idx).unary_stream
+                if stream
+                else self._channel(idx).unary_unary
+            )
+            resp_cls = (_STREAM_METHODS if stream else _METHODS)[name][1]
+            stub = factory(
                 f"/{SERVICE_NAME}/{name}",
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=resp_cls.FromString,
             )
-        self._stream_stubs = {}
-        for name, (req_cls, resp_cls) in _STREAM_METHODS.items():
-            self._stream_stubs[name] = self.channel.unary_stream(
-                f"/{SERVICE_NAME}/{name}",
-                request_serializer=lambda m: m.SerializeToString(),
-                response_deserializer=resp_cls.FromString,
-            )
+            self._stub_cache[key] = stub
+        return stub
+
+    def active_endpoint(self):
+        return self.endpoints[self._active]
+
+    def rotate_endpoint(self) -> None:
+        """Advance to the next endpoint (no-op with one). Benign under
+        concurrent callers: _active is a plain index and every value of it
+        names a valid endpoint."""
+        if len(self.endpoints) > 1:
+            self._active = (self._active + 1) % len(self.endpoints)
+
+    def prefer_endpoint(self, addr: str) -> bool:
+        """Jump to the endpoint named by a `host:port` ownership hint
+        (GetJobStatusResult.owner_addr). True iff this SWITCHED the active
+        endpoint; unknown addresses are ignored — the hint optimizes
+        rotation, it never widens the configured endpoint set."""
+        host, _, port = addr.rpartition(":")
+        try:
+            ep = (host, int(port))
+        except ValueError:
+            return False
+        if ep not in self.endpoints or ep == self.endpoints[self._active]:
+            return False
+        self._active = self.endpoints.index(ep)
+        return True
+
+    def _prefer_from_detail(self, detail: str) -> bool:
+        """Parse a replica's ownership-redirect detail (`... owned by peer
+        replica '<id>' at <host:port>; ...`) and jump to the named owner.
+        False when the detail carries no usable hint."""
+        if "owned by peer replica" not in detail:
+            return False
+        _, _, rest = detail.partition(" at ")
+        addr = rest.split(";", 1)[0].strip()
+        return bool(addr) and self.prefer_endpoint(addr)
 
     def _chaos_key(self, name: str) -> str:
         # per-method call index: a RETRY of a failed call draws a fresh
@@ -171,7 +244,7 @@ class SchedulerGrpcClient:
             try:
                 if self.chaos is not None:
                     self.chaos.maybe_fail("rpc.call", self._chaos_key(name))
-                return self._stubs[name](params)
+                return self._stub(name)(params)
             except ChaosInjected as e:
                 transient, detail, err = True, str(e), e
             except grpc.RpcError as e:
@@ -194,6 +267,13 @@ class SchedulerGrpcClient:
             if not transient or i + 1 >= attempts:
                 raise RpcError(f"{name} failed: {detail}") from err
             record_recovery("rpc_retry")
+            # replica failover (ISSUE 20): try another endpoint before
+            # sleeping — a dead or redirecting replica should cost one
+            # backoff step, not the whole retry budget. An ownership
+            # redirect names the owner in its detail; jump straight there
+            # when it is a configured endpoint, else rotate blind.
+            if not self._prefer_from_detail(detail):
+                self.rotate_endpoint()
             time.sleep(backoff_delay(i, self.backoff_s))
         raise AssertionError("unreachable")  # loop always returns or raises
 
@@ -208,8 +288,10 @@ class SchedulerGrpcClient:
         call object — an iterator of TaskDefinition that also supports
         .cancel(). NO retry wrapper here: stream life-cycle (reconnect with
         backoff, fallback to polling while down) belongs to the subscribe
-        loop in executor/execution_loop.py, which must observe every drop."""
-        return self._stream_stubs["SubscribeWork"](params)
+        loop in executor/execution_loop.py, which must observe every drop.
+        Opens against the ACTIVE endpoint — after a failover rotated the
+        client, a re-subscribe lands on the adopting replica."""
+        return self._stub("SubscribeWork", stream=True)(params)
 
     def get_job_status(self, params: pb.GetJobStatusParams) -> pb.GetJobStatusResult:
         return self._call("GetJobStatus", params)
@@ -218,8 +300,9 @@ class SchedulerGrpcClient:
         """Open the push job-status stream (ISSUE 11). Returns the live
         gRPC call object — an iterator of GetJobStatusResult that also
         supports .cancel(). NO retry wrapper, like subscribe_work: the
-        client's status-watch helper owns fallback-to-polling on any drop."""
-        return self._stream_stubs["SubscribeJobStatus"](params)
+        client's status-watch helper owns fallback-to-polling on any drop.
+        Opens against the ACTIVE endpoint (re-homed by owner_addr hints)."""
+        return self._stub("SubscribeJobStatus", stream=True)(params)
 
     def get_executors_metadata(self) -> pb.GetExecutorMetadataResult:
         return self._call("GetExecutorsMetadata", pb.GetExecutorMetadataParams())
@@ -243,4 +326,5 @@ class SchedulerGrpcClient:
         )
 
     def close(self) -> None:
-        self.channel.close()
+        for ch in self._channels.values():
+            ch.close()

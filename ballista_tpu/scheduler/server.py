@@ -20,6 +20,7 @@ import queue
 import random
 import string
 import threading
+import time
 from concurrent import futures
 from typing import Dict, Optional
 
@@ -84,6 +85,8 @@ class SchedulerServer:
         namespace: str = "default",
         config: Optional[BallistaConfig] = None,
         synchronous_planning: bool = False,
+        replica_id: str = "",
+        advertise_addr: str = "",
     ) -> None:
         self.config = config or BallistaConfig()  # durability: ephemeral(construction parameter)
         # ISSUE 14: one config flag arms the dynamic lock-order witness for
@@ -92,6 +95,12 @@ class SchedulerServer:
 
         _locks.maybe_enable_from_config(self.config)
         self.state = SchedulerState(kv or MemoryBackend(), namespace, config=self.config)  # durability: ephemeral(the owned SchedulerState, classified field by field)
+        # replica identity (ISSUE 20) lands BEFORE recovery: recover()'s
+        # lease reclaim compares replica ids, and a replica restarting
+        # under its own name must reclaim its predecessor's surviving
+        # leases instead of treating them as a live peer's
+        self.state.replica_id = replica_id
+        self.state.replica_addr = advertise_addr
         # restart recovery BEFORE serving: discard torn (uncommitted) jobs,
         # reload the durable assignment ledger with a fresh grace window
         # (no-op with zero counters on a fresh store)
@@ -155,6 +164,19 @@ class SchedulerServer:
         # task completion; one push per TRANSITION means suppressing those
         self._status_last: Dict[str, bytes] = {}  # durability: ephemeral(push dedup memo, a reconnected stream gets a fresh snapshot)  # guarded-by: self._status_mu
         self.state.on_job_status = self._notify_job_status
+        # replicated-control-plane housekeeping (ISSUE 20): the daemon that
+        # renews this replica's job leases, adopts dead peers' expired jobs,
+        # and fails queued jobs whose planning replica died mid-plan. Started
+        # from serve() ONLY — in-process test servers must not leak threads.
+        self._hk_stop = threading.Event()  # durability: ephemeral(live thread plumbing)
+        self._hk_thread: Optional[threading.Thread] = None  # durability: ephemeral(live thread handle, dies with the process)
+        # job ids THIS replica is still planning/advancing: the queued-grace
+        # sweep must never fail a job whose planner is alive in this very
+        # process (set add/discard are atomic under the GIL)
+        self._planning: set = set()  # durability: ephemeral(in-flight planning threads die with the process; peers judge them by the replica heartbeat instead)
+        # scheduler-side shared-shuffle TTL sweep (ISSUE 20 satellite,
+        # ROADMAP residue): same 1h TTL as the executor-side sweep
+        self.shuffle_ttl_seconds = 3600.0  # durability: ephemeral(tuning knob)
 
     # -- crash simulation ---------------------------------------------------
     def _refuse_if_crashed(self, context) -> None:
@@ -178,7 +200,8 @@ class SchedulerServer:
             "status #%d", self._accepted_statuses,
         )
         self.crashed = True
-        # a dead process's streams die with it
+        # a dead process's housekeeping and streams die with it
+        self._hk_stop.set()
         self.close_push_streams()
         if self.on_crash is not None:
             try:
@@ -186,6 +209,225 @@ class SchedulerServer:
             except Exception as e:
                 log.warning("on_crash hook failed: %s", e)
         self._refuse_if_crashed(context)
+
+    # -- replicated-control-plane housekeeping (ISSUE 20) -------------------
+    def start_housekeeping(self) -> None:
+        """Start the replica housekeeping daemon: lease renewal (every
+        ~TTL/3), the replica liveness heartbeat, adoption of dead peers'
+        expired running jobs, the queued-grace sweep, and the scheduler-
+        side shared-shuffle TTL sweep. Called from serve() — never from
+        __init__, so the hundreds of in-process test servers stay
+        thread-free."""
+        if self._hk_thread is not None or self.crashed:
+            return
+        self._hk_stop.clear()
+        self._hk_thread = threading.Thread(
+            target=self._housekeeping_loop, daemon=True,
+            name=f"scheduler-housekeeping-{self.state.replica_id or 'solo'}",
+        )
+        self._hk_thread.start()
+
+    def stop_housekeeping(self) -> None:
+        self._hk_stop.set()
+        t = self._hk_thread
+        if t is not None:
+            t.join(timeout=5)
+            self._hk_thread = None
+
+    def _housekeeping_loop(self) -> None:
+        from ballista_tpu.utils.chaos import ChaosInjected
+
+        state = self.state
+        # renew at a third of the TTL: two consecutive torn/missed rounds
+        # still leave the lease alive, three depose us truthfully
+        tick = max(0.05, state._lease_ttl / 3.0)
+        renew_seq = 0
+        queued_seen: Dict[str, float] = {}  # job -> first seen queued, grace clock
+        last_shuffle_sweep = time.time()
+        while not self._hk_stop.wait(tick):
+            if self.crashed:
+                return
+            try:
+                renew_seq += 1
+                # scheduler.lease chaos: one torn RENEWAL round — the
+                # heartbeat and every owned lease burn a round of TTL
+                # budget; enough consecutive verdicts and peers adopt this
+                # replica's jobs, which is exactly the failure under test
+                if self._chaos is not None:
+                    self._chaos.maybe_fail(
+                        "scheduler.lease",
+                        f"g{state.generation}/renew{renew_seq}",
+                    )
+                with state.kv.lock():
+                    state.replica_heartbeat()
+                    state.renew_owned_leases()
+            except ChaosInjected:
+                log.warning(
+                    "chaos[scheduler.lease]: renewal round %d skipped",
+                    renew_seq,
+                )
+            except Exception:
+                log.warning("lease renewal round failed", exc_info=True)
+            try:
+                with state.kv.lock():
+                    if self._adopt_orphaned_jobs_locked():
+                        self._pump_pushes()
+                    self._sweep_queued_grace_locked(queued_seen)
+            except ChaosInjected:
+                pass  # kv.lease tore an adoption claim; next round retries
+            except Exception:
+                log.warning("failover scan failed", exc_info=True)
+            now = time.time()
+            if now - last_shuffle_sweep >= 60.0:
+                last_shuffle_sweep = now
+                try:
+                    self.sweep_shuffle_dir()
+                except Exception:
+                    log.warning("shuffle-dir sweep failed", exc_info=True)
+
+    def _adopt_orphaned_jobs_locked(self) -> int:
+        """Adopt every running job whose owner's lease expired (caller
+        holds the global KV lock). The leasegen/ scan finds exactly the
+        jobs that HAVE had owners; a live lease means the owner still
+        heartbeats and the job is not ours to touch. adopt_job runs
+        recovery scoped to the job — assignment/speculation ledgers
+        reload, orphan grace restarts — so failover is the restart story
+        executed by a peer."""
+        state = self.state
+        adopted = 0
+        for key, _gen in state.kv.get_prefix(state._key("leasegen", "")):
+            job_id = key.rsplit("/", 1)[1]
+            if state.owns_job(job_id):
+                continue
+            if state.kv.get(state._lease_key(job_id)) is not None:
+                continue  # owner alive (or a peer just adopted)
+            st = state.get_job_metadata(job_id)
+            if st is None or st.WhichOneof("status") != "running":
+                continue
+            if state.adopt_job(job_id):
+                adopted += 1
+                log.warning(
+                    "replica %s adopted job %s from its expired owner",
+                    state.replica_id or "<solo>", job_id,
+                )
+        return adopted
+
+    def _sweep_queued_grace_locked(self, queued_seen: Dict[str, float]) -> int:
+        """Fail queued jobs whose submitting replica died before the
+        planning commit (caller holds the global KV lock). Scoped hard:
+        only jobs carrying a planner/ provenance stamp whose replica
+        heartbeat lapsed, never this replica's own in-flight planning,
+        and only after a 2xTTL grace. The failure is a CAS against the
+        exact queued bytes — racing the (resurrected) planner's atomic
+        commit, exactly one of the two writes lands."""
+        from ballista_tpu.ops.runtime import record_recovery
+
+        state = self.state
+        now = time.time()
+        failed_n = 0
+        live = set()
+        for key, raw in state.kv.get_prefix(state._key("jobs", "")):
+            job_id = key.rsplit("/", 1)[1]
+            st = pb.JobStatus()
+            try:
+                st.ParseFromString(raw)
+            except Exception:
+                continue
+            if st.WhichOneof("status") != "queued":
+                continue
+            live.add(job_id)
+            if job_id in self._planning:
+                continue
+            planner = state.job_planner(job_id)
+            if planner is None:
+                continue  # anonymous submission: restart recovery owns it
+            if planner != state.replica_id and state.replica_alive(planner):
+                queued_seen.pop(job_id, None)  # planner heartbeating: reset
+                continue
+            # our own stamp but not in self._planning: the planner thread
+            # died with a predecessor process (restart under the same
+            # replica id, with live peers suppressing the full-recovery
+            # torn-job sweep) — grace applies to us like any dead peer
+            first = queued_seen.setdefault(job_id, now)
+            if now - first < 2.0 * state._lease_ttl:
+                continue
+            failed = pb.JobStatus()
+            failed.failed.error = (
+                f"planning replica {planner!r} died before committing "
+                "the job's plan"
+            )
+            if state.kv.put_all(
+                [(key, failed.SerializeToString())], compare=(key, raw)
+            ):
+                failed_n += 1
+                record_recovery("queued_grace_failed")
+                log.warning(
+                    "queued job %s failed: planner replica %r lapsed "
+                    "without committing", job_id, planner,
+                )
+            queued_seen.pop(job_id, None)
+        # drop grace clocks for jobs that left queued (committed/failed)
+        for job_id in [j for j in queued_seen if j not in live]:
+            queued_seen.pop(job_id, None)
+        return failed_n
+
+    def _peer_with_pending_work_locked(self):
+        """A live peer's (job_id, JobLease) whose job still has PENDING
+        tasks (caller holds the global KV lock) — the re-home target for an
+        idle executor this replica has nothing to dispatch to. None when
+        every leased job is ours, drained, or address-less. Runs only on
+        fully idle polls, whose frequency decays toward the idle ceiling."""
+        state = self.state
+        for key, raw in state.kv.get_prefix(state._key("leases", "")):
+            job_id = key.rsplit("/", 1)[1]
+            if state.owns_job(job_id):
+                continue
+            jl = pb.JobLease()
+            try:
+                jl.ParseFromString(raw)
+            except Exception:
+                continue
+            if not jl.addr or jl.addr == state.replica_addr:
+                continue
+            for _k, v in state.kv.get_prefix(state._key("tasks", job_id) + "/"):
+                ts = pb.TaskStatus()
+                try:
+                    ts.ParseFromString(v)
+                except Exception:
+                    continue
+                if ts.WhichOneof("status") is None:
+                    return job_id, jl
+        return None
+
+    def sweep_shuffle_dir(self) -> int:
+        """Scheduler-side TTL sweep of the shared shuffle root (ISSUE 20
+        satellite, ROADMAP residue): executors sweep the mount too, but a
+        fleet scaled to zero — or torn down uncleanly — leaves nobody else
+        to reclaim expired job dirs, and the mount would grow without
+        bound. Same TTL and racing-rmtree tolerance as the executor
+        sweep (executor/execution_loop.py::gc_work_dir)."""
+        import os
+        import shutil
+
+        root = self.config.shuffle_dir()
+        if not root or not os.path.isdir(root):
+            return 0
+        removed = 0
+        cutoff = time.time() - self.shuffle_ttl_seconds
+        for job_dir in os.listdir(root):
+            path = os.path.join(root, job_dir)
+            try:
+                if os.path.isdir(path) and os.path.getmtime(path) < cutoff:
+                    shutil.rmtree(path, ignore_errors=True)
+                    removed += 1
+            except OSError:
+                continue
+        if removed:
+            log.info(
+                "scheduler shuffle sweep: removed %d expired job dirs",
+                removed,
+            )
+        return removed
 
     # -- RPC implementations ------------------------------------------------
     def ExecuteQuery(self, request: pb.ExecuteQueryParams, context=None) -> pb.ExecuteQueryResult:
@@ -304,6 +546,9 @@ class SchedulerServer:
         queued = pb.JobStatus()
         queued.queued.SetInParent()
         self.state.save_job_metadata(job_id, queued)
+        # queued-grace provenance (ISSUE 20): peers may fail this job if
+        # this replica dies before the planning commit
+        self.state.mark_job_planner(job_id)
         # per-job client settings ride TaskDefinition to executors (the
         # reference drops its settings map, serde/scheduler/to_proto.rs:29-35)
         self.state.save_job_settings(job_id, settings)
@@ -318,7 +563,11 @@ class SchedulerServer:
 
         content_key = fp[0] if (fp is not None and config.plan_cache()) else None
         if self.synchronous_planning:
-            self._plan_job(job_id, plan, config, content_key=content_key)
+            self._planning.add(job_id)
+            try:
+                self._plan_job(job_id, plan, config, content_key=content_key)
+            finally:
+                self._planning.discard(job_id)
         else:
             threading.Thread(
                 target=self._plan_job_safe,
@@ -328,6 +577,16 @@ class SchedulerServer:
         return pb.ExecuteQueryResult(job_id=job_id)
 
     def _plan_job_safe(self, job_id: str, plan, config, content_key=None) -> None:
+        self._planning.add(job_id)
+        try:
+            self._plan_job_guarded(job_id, plan, config, content_key)
+        finally:
+            # only now may a peer's queued-grace sweep judge the job: past
+            # this point either the commit landed (running) or a terminal
+            # failed status did — a still-queued job is truly abandoned
+            self._planning.discard(job_id)
+
+    def _plan_job_guarded(self, job_id: str, plan, config, content_key=None) -> None:
         from ballista_tpu.ops.runtime import record_recovery
         from ballista_tpu.utils.chaos import ChaosInjected
 
@@ -399,6 +658,7 @@ class SchedulerServer:
         queued = pb.JobStatus()
         queued.queued.SetInParent()
         self.state.save_job_metadata(job_id, queued)
+        self.state.mark_job_planner(job_id)
         self.state.save_job_settings(job_id, settings)
         self.state.save_job_tenant(job_id, tenant, priority)
         self.state.save_job_fingerprint(job_id, fp[1])
@@ -407,6 +667,10 @@ class SchedulerServer:
             "job %s advancing cached result (epoch %d, +%d file(s), fp=%s...)",
             job_id, base.advance_epoch, len(new_files), fp[1][:16],
         )
+        # the user job stays QUEUED for the whole advancement (possibly
+        # minutes of delta-job execution): shield it from peers' queued-
+        # grace sweeps for as long as this worker lives
+        self._planning.add(job_id)
         threading.Thread(
             target=self._advance_job_safe,
             args=(job_id, plan, config, settings, tenant, priority, fp,
@@ -428,6 +692,16 @@ class SchedulerServer:
         chaos-torn publish — declines: recorded, logged, and the user job
         replans as a full recompute, so the fold is only ever an
         accelerator on a path whose fallback is the bit-identical truth."""
+        try:
+            self._advance_job(job_id, plan, config, settings, tenant,
+                              priority, fp, facts, base, new_files, spec)
+        finally:
+            self._planning.discard(job_id)
+
+    def _advance_job(
+        self, job_id, plan, config, settings, tenant, priority, fp, facts,
+        base, new_files, spec,
+    ) -> None:
         import time as _time
 
         from ballista_tpu.config import BALLISTA_DELTA_FOR
@@ -455,6 +729,7 @@ class SchedulerServer:
                     # no jobfp/jobfacts: a delta job's partial result must
                     # never enter the result cache under any key
                     self.state.save_job_metadata(dj, queued)
+                    self.state.mark_job_planner(dj)
                     self.state.save_job_settings(dj, dsettings)
                     self.state.save_job_tenant(dj, tenant, priority)
                 threading.Thread(
@@ -538,6 +813,16 @@ class SchedulerServer:
         if content_key is not None:
             with self._plan_cache_mu:
                 blob = self._plan_cache.get(content_key)
+            kv_hit = False
+            if blob is None:
+                # KV read-through tier (ISSUE 20): a peer replica's
+                # planning output serves this replica's first miss — N
+                # replicas sharing an admission load plan each dashboard
+                # query ONCE cluster-wide, not once per replica
+                blob = self.state.kv.get(
+                    self.state._key("plancache", content_key)
+                )
+                kv_hit = blob is not None
             if blob is not None:
                 # a cached blob that stops deserializing (e.g. after a code
                 # change mid-process) must evict and fall through to fresh
@@ -549,8 +834,13 @@ class SchedulerServer:
                 except Exception:
                     with self._plan_cache_mu:
                         self._plan_cache.pop(content_key, None)
+                    self.state.kv.delete(
+                        self.state._key("plancache", content_key)
+                    )
                 else:
                     record_tenancy("plan_cache_hit")
+                    if kv_hit:
+                        self._plan_cache_insert(content_key, blob)
                     return plan_tree
         # distributed jobs keep the Partial/exchange/Final shape: the stage
         # split parallelizes across executors, and the SPMD fuse needs it
@@ -569,14 +859,23 @@ class SchedulerServer:
                 fresh = phys_plan_from_proto(node)
             except Exception:
                 return physical  # unserializable plans just don't cache
-            with self._plan_cache_mu:
-                if len(self._plan_cache) >= self._plan_cache_cap:
-                    # drop the oldest insertion (dict preserves order) —
-                    # a simple bound, not an LRU; the cap is generous
-                    self._plan_cache.pop(next(iter(self._plan_cache)))
-                self._plan_cache[content_key] = blob
+            self._plan_cache_insert(content_key, blob)
+            # the KV tier is namespace-lifetime (no cap): plancache rows
+            # are keyed by plan content and die with the store, like
+            # resultcache entries
+            self.state.kv.put(
+                self.state._key("plancache", content_key), blob
+            )
             return fresh
         return physical
+
+    def _plan_cache_insert(self, content_key: str, blob: bytes) -> None:
+        with self._plan_cache_mu:
+            if len(self._plan_cache) >= self._plan_cache_cap:
+                # drop the oldest insertion (dict preserves order) —
+                # a simple bound, not an LRU; the cap is generous
+                self._plan_cache.pop(next(iter(self._plan_cache)))
+            self._plan_cache[content_key] = blob
 
     def _plan_job(
         self, job_id: str, plan, config, attempt: int = 0, content_key=None
@@ -684,6 +983,20 @@ class SchedulerServer:
         whenever this stream is down, refused, or racing a restart."""
         self._refuse_if_crashed(context)
         job_id = request.job_id
+        # push is replica-LOCAL by design (ISSUE 20): status transitions
+        # fan out from the replica that writes them — subscribing here for
+        # a live peer's job would hold a silent stream. Refuse with the
+        # owner's address; the client re-homes (or its poll fallback,
+        # which reads shared KV truth, carries it to completion).
+        lease = self.state.job_lease(job_id)
+        if lease is not None and not self.state.owns_job(job_id):
+            detail = (
+                f"job {job_id} owned by peer replica {lease.replica_id!r}"
+                f" at {lease.addr}; subscribe there"
+            )
+            if context is not None:
+                context.abort(grpc.StatusCode.UNAVAILABLE, detail)
+            raise RuntimeError(detail)
         q: "queue.Queue" = queue.Queue()
         with self._status_mu:
             self._status_subs.setdefault(job_id, []).append(q)
@@ -911,8 +1224,24 @@ class SchedulerServer:
                 n = self.state.reset_lost_tasks()
                 if n:
                     log.warning("re-scheduled %d tasks from dead executors", n)
+            # ownership gate (ISSUE 20): fold statuses only for jobs this
+            # replica owns — adopting expired-lease jobs on the spot (the
+            # thread-free half of failover). Statuses for a live PEER's
+            # jobs are left on the executor's queue: the poll still folds
+            # everything writable, then ends in a redirecting UNAVAILABLE
+            # so the executor's retry loop re-homes to the owner and
+            # re-delivers (accept_task_status is idempotent).
+            foreign: Dict[str, pb.JobLease] = {}
+            for job_id in sorted(
+                {ts.partition_id.job_id for ts in request.task_status}
+            ):
+                holder = self.state.ensure_job_writable(job_id)
+                if holder is not None:
+                    foreign[job_id] = holder
             jobs = set()
             for ts in request.task_status:
+                if ts.partition_id.job_id in foreign:
+                    continue
                 # stale reports from already-reset attempts are dropped;
                 # accepted ones keep the KV-side attempt history
                 if self.state.accept_task_status(ts):
@@ -960,7 +1289,10 @@ class SchedulerServer:
                              ts.attempt)
                         )
             result = pb.PollWorkResult()
-            if request.can_accept_task:
+            # no dispatch on a poll that is about to redirect: an assigned
+            # task would flip Running durably and then die with the abort,
+            # riding the 3s orphan grace for nothing
+            if request.can_accept_task and not foreign:
                 speculative = False
                 assigned = self.state.assign_next_schedulable_task(request.metadata.id)
                 if assigned is None:
@@ -991,6 +1323,58 @@ class SchedulerServer:
             # credit resolution above freed slots): dispatch the newly
             # runnable work NOW instead of waiting for a subscriber tick
             self._pump_pushes()
+            if foreign:
+                from ballista_tpu.ops.runtime import record_recovery
+
+                job_id, holder = sorted(foreign.items())[0]
+                record_recovery("ownership_redirected")
+                detail = (
+                    f"job {job_id} owned by peer replica "
+                    f"{holder.replica_id!r} at {holder.addr}; re-home"
+                )
+                log.info("PollWork(%s) redirected: %s",
+                         request.metadata.id, detail)
+                if context is not None:
+                    context.abort(grpc.StatusCode.UNAVAILABLE, detail)
+                raise RuntimeError(detail)
+            # idle-capacity re-home (ISSUE 20): a fully idle executor (no
+            # statuses, no echoes, nothing assigned this poll) polled a
+            # replica with nothing to dispatch while a live peer owns a job
+            # that still has PENDING tasks. Without this, an executor homed
+            # to a workless replica never learns a failover moved its work:
+            # the non-owner answers empty polls forever. Bounce it to the
+            # owner — the client's retry loop jumps endpoints on the named
+            # address, and closing the local push stream (idle by the same
+            # check) makes the re-subscribe follow.
+            if (
+                not result.HasField("task")
+                and not request.task_status
+                and not len(request.running_echo)
+                and not len(request.running_tasks)
+            ):
+                hint = self._peer_with_pending_work_locked()
+                if hint is not None:
+                    job_id, holder = hint
+                    with self._push_mu:
+                        sub = self._subscribers.get(request.metadata.id)
+                        if sub is not None and sub.outstanding:
+                            # pushed work in flight: not idle after all
+                            return result
+                        self._subscribers.pop(request.metadata.id, None)
+                    if sub is not None:
+                        sub.close()
+                    from ballista_tpu.ops.runtime import record_recovery
+
+                    record_recovery("idle_rehomed")
+                    detail = (
+                        f"job {job_id} owned by peer replica "
+                        f"{holder.replica_id!r} at {holder.addr}; re-home"
+                    )
+                    log.info("PollWork(%s) idle re-home: %s",
+                             request.metadata.id, detail)
+                    if context is not None:
+                        context.abort(grpc.StatusCode.UNAVAILABLE, detail)
+                    raise RuntimeError(detail)
             return result
 
     def GetJobStatus(self, request: pb.GetJobStatusParams, context=None) -> pb.GetJobStatusResult:
@@ -999,6 +1383,17 @@ class SchedulerServer:
         result = pb.GetJobStatusResult()
         if status is not None:
             result.status.CopyFrom(status)
+            # ownership hint (ISSUE 20): the status itself is KV truth and
+            # answers from ANY replica, but push subscriptions and lost-
+            # partition reports belong on the owner — hand clients its
+            # address when that is a live peer
+            lease = self.state.job_lease(request.job_id)
+            if (
+                lease is not None
+                and lease.addr
+                and not self.state.owns_job(request.job_id)
+            ):
+                result.owner_addr = lease.addr
         return result
 
     def ReportLostPartition(
@@ -1014,6 +1409,18 @@ class SchedulerServer:
         completed on that executor — the client re-raises its fetch error."""
         self._refuse_if_crashed(context)
         with self.state.kv.lock():
+            # restart surgery belongs on the owner (ISSUE 20): it rewrites
+            # task statuses and the assignment ledger. Adopt expired-lease
+            # jobs on the spot; redirect for a live peer's.
+            holder = self.state.ensure_job_writable(request.job_id)
+            if holder is not None:
+                detail = (
+                    f"job {request.job_id} owned by peer replica "
+                    f"{holder.replica_id!r} at {holder.addr}; report there"
+                )
+                if context is not None:
+                    context.abort(grpc.StatusCode.UNAVAILABLE, detail)
+                raise RuntimeError(detail)
             n = self.state.restart_completed_job(
                 request.job_id, request.executor_id
             )
@@ -1119,6 +1526,10 @@ def serve(
     if bound == 0:
         raise RuntimeError(f"cannot bind scheduler to {bind_host}:{port}")
     server.start()
+    # a SERVING scheduler runs replica housekeeping (ISSUE 20): lease
+    # renewal, dead-peer adoption, queued-grace, shuffle-dir TTL sweep.
+    # In-process test servers that never serve() stay thread-free.
+    server_impl.start_housekeeping()
     # SubscribeWork streams (ISSUE 8) hold their worker thread inside the
     # response generator until cancelled; a process exiting WITHOUT a clean
     # cluster shutdown would then hang in ThreadPoolExecutor's atexit join

@@ -15,6 +15,20 @@ backend resumes mid-job. Key layout (ref state/mod.rs:387-434):
     jobfp/{job_id}                  result-cache fingerprint of the job
     resultcache/{fingerprint}       ResultCacheEntry (completed locations)
     meta/restart_generation         int (bumped by each restart recovery)
+    leases/{job_id}                 JobLease, TTL-leased (ISSUE 20: which
+                                    replica owns the job + fencing gen)
+    leasegen/{job_id}               int (monotonic fencing-generation
+                                    counter; outlives each lease)
+    meta/plan_epoch                 int (bumped on task-set mutations so
+                                    peer task indexes re-seed on change)
+    meta/rc_epoch                   int (bumped on result-cache count
+                                    changes; peers re-derive the count)
+    replicas/{replica_id}           replica liveness heartbeat, TTL-leased
+                                    (renewed by the housekeeping thread)
+    planner/{job_id}                which replica accepted the submission
+                                    (queued-grace provenance, ISSUE 20)
+    plancache/{content_key}         serialized PhysicalPlanNode — the KV
+                                    tier of the cross-job plan cache
 
 Crash tolerance (ISSUE 6): planning writes publish atomically through
 KvBackend.put_all (the `running` job status is the commit marker — a job
@@ -24,6 +38,18 @@ reloads it, and `recover()` folds the reloaded ledger against executors'
 PollWork `running_echo` — tasks the owner still runs are re-adopted,
 tasks nobody vouches for within the grace window requeue through the
 normal retry/lineage path.
+
+Replicated control plane (ISSUE 20): N scheduler replicas share one KV
+store, and job ownership shards by lease — `leases/{job}` is minted
+atomically WITH the planning commit (same put_all) and renewed by the
+owner; replica death is lease expiry, and an idle peer adopts the dead
+replica's jobs by running recover() scoped to them (failover = restart
+recovery run by a peer). Every job-scoped durable write by an owner is a
+compare-and-swap against its remembered lease value (the FENCING rule):
+a deposed-but-alive owner's stale writes are rejected whole, and the
+rejection drops its local ownership. The fencing generation is minted
+from the durable `leasegen/{job}` counter in the same atomic batch, so
+generations never repeat across adoptions.
 """
 
 from __future__ import annotations
@@ -256,7 +282,8 @@ class JobPlanBatch:
         self._tasks.append(pending)
 
     def commit(self) -> None:
-        """Publish the whole plan + the queued->running flip atomically."""
+        """Publish the whole plan + the queued->running flip atomically,
+        minting the job's ownership lease in the same batch (ISSUE 20)."""
         self._chaos("commit")
         running = pb.JobStatus()
         running.running.SetInParent()
@@ -264,7 +291,7 @@ class JobPlanBatch:
             self._state._key("jobs", self.job_id),
             running.SerializeToString(),
         )]
-        self._state.kv.put_all(items)
+        self._state.commit_plan_batch(self.job_id, items)
         # index only AFTER the publish succeeded: an aborted batch must
         # leave no trace, in the index included
         if self._state._task_index is not None:
@@ -441,6 +468,37 @@ class SchedulerState:
         # self-corrects exactly when it would matter. All mutation happens
         # under the global KV lock the cache paths already hold.
         self._rc_count: Optional[int] = None  # durability: derived(_ensure_rc_count)
+        # -- replicated control plane (ISSUE 20) ----------------------------
+        # this replica's identity. "" is the single-scheduler default: a
+        # restarted singleton sees its predecessor's leases carry the same
+        # (empty) replica id and reclaims them, so every pre-replication
+        # restart test keeps its exact semantics.
+        self.replica_id = ""  # durability: ephemeral(replica identity, assigned by the owning server)
+        self.replica_addr = ""  # durability: ephemeral(advertised host:port, assigned by the owning server)
+        # job -> the exact serialized JobLease WE minted (the fencing token).
+        # Every job-scoped durable write CASes against this value; a mismatch
+        # means a peer adopted the job and this entry drops (_deposed). The
+        # durable truth is leases/{job} itself — minted atomically with the
+        # planning commit, recovered by re-minting in recover()/adopt_job.
+        self._owned: Dict[str, bytes] = {}  # durability: durable(leases)
+        self._lease_ttl = float(self.config.scheduler_lease_ttl_s())  # durability: ephemeral(config snapshot)
+        # kv.lease chaos rotation (like _chaos_puts): generation-folded so a
+        # restarted scheduler draws fresh verdicts; under the kv lock
+        self._lease_seq = 0  # durability: ephemeral(per-process chaos sequence)
+        # fencing telemetry: stale writes rejected because a peer holds the
+        # lease now. Counts REJECTIONS observed by this (deposed) replica.
+        self.fence_rejected = 0  # durability: ephemeral(telemetry counter, meaningful per life)
+        # jobs this replica was deposed FROM: they must not degrade to the
+        # unfenced never-leased write path — every later write stays
+        # rejected until adopt_job re-claims the lease for real. The
+        # durable truth is leases/{job}; this only pins the local verdict.
+        self._deposed_jobs: set = set()  # durability: ephemeral(local deposition memory; the lease row is the durable truth)
+        # generation-stamped read-through views (ISSUE 20): the derived
+        # task-index / rc-count caches were single-scheduler-fresh by
+        # construction; with peers mutating the same KV they re-derive when
+        # the durable epoch moves. None = never read the epoch yet.
+        self._plan_epoch_seen: Optional[int] = None  # durability: derived(_ensure_task_index)
+        self._rc_epoch_seen: Optional[int] = None  # durability: derived(_ensure_rc_count)
 
     def _key(self, *parts: str) -> str:
         return "/".join(("/ballista", self.namespace) + parts)
@@ -457,7 +515,9 @@ class SchedulerState:
         monotonic grace-window clock, the KV carries the restart truth."""
         self._assigned[key] = (executor_id, attempt, time.monotonic(), False)
         msg = pb.Assignment(executor_id=executor_id, attempt=attempt)
-        self.kv.put(self._ledger_key(key), msg.SerializeToString())
+        # fenced (ISSUE 20): a rejected write means a peer adopted the job —
+        # _fenced_put's deposition purge drops the entry just added above
+        self._fenced_put(key[0], self._ledger_key(key), msg.SerializeToString())
 
     def _ledger_del(self, key: Tuple[str, int, int]) -> None:
         self._assigned.pop(key, None)
@@ -478,7 +538,8 @@ class SchedulerState:
             executor_id, attempt, time.monotonic(), False, False,
         )
         msg = pb.Assignment(executor_id=executor_id, attempt=attempt)
-        self.kv.put(self._spec_key(key), msg.SerializeToString())
+        # fenced like _ledger_put: rejection purges the entry via _deposed
+        self._fenced_put(key[0], self._spec_key(key), msg.SerializeToString())
 
     def _spec_del(self, key: Tuple[str, int, int]) -> None:
         if self._speculative.pop(key, None) is not None:
@@ -530,52 +591,412 @@ class SchedulerState:
             except Exception:
                 log.debug("job-status notification failed", exc_info=True)
 
-    def recover(self) -> Dict[str, int]:
-        """Scheduler-restart recovery: called once before serving (the
-        caller holds no lock yet — nothing else can touch this state).
+    # -- job-ownership leases + write fencing (ISSUE 20) --------------------
+    def _lease_key(self, job_id: str) -> str:
+        return self._key("leases", job_id)
 
-        - A job still QUEUED was never committed: planning publishes stages,
-          tasks, and the `running` flip in ONE atomic put_all, and the
-          logical plan lived only in the dead scheduler's memory — so the
-          job is failed cleanly ("resubmit") instead of hanging the client
-          forever, and any stray keys a non-transactional backend might
-          have leaked are discarded. NOTE: on a SHARED (multi-scheduler)
-          namespace this would fail a peer's in-flight planning; restart
-          recovery assumes the single-scheduler deployments this repo runs.
-        - RUNNING jobs resume as-is (tasks/stages/settings are already in
-          the KV; the task index reseeds from a scan).
+    def _leasegen_key(self, job_id: str) -> str:
+        return self._key("leasegen", job_id)
+
+    def _lease_chaos(self) -> None:
+        """kv.lease injection seam: the lease mint/claim op fails as if the
+        store dropped the request. Keyed like kv.put on a generation-rotated
+        per-process sequence (under the kv lock) so a retried mint draws a
+        fresh deterministic verdict."""
+        if self._chaos is not None:
+            self._lease_seq += 1
+            self._chaos.maybe_fail(
+                "kv.lease", f"g{self.generation}/lease{self._lease_seq}"
+            )
+
+    def _mint_lease_items(self, job_id: str) -> Tuple[bytes, Tuple[str, bytes]]:
+        """Next fencing generation for the job: read the durable
+        `leasegen/{job}` counter and build (serialized JobLease to grant,
+        the counter write that must ride the SAME atomic batch). The
+        counter outlives each lease on purpose — fencing generations stay
+        monotonic across any number of expiries and adoptions."""
+        prior = self.kv.get(self._leasegen_key(job_id))
+        fence = (int(prior) if prior else 0) + 1
+        lease = pb.JobLease(
+            replica_id=self.replica_id, fence=fence, addr=self.replica_addr
+        )
+        return (
+            lease.SerializeToString(),
+            (self._leasegen_key(job_id), str(fence).encode()),
+        )
+
+    def job_lease(self, job_id: str) -> Optional[pb.JobLease]:
+        """The live ownership lease, or None (expired / never leased)."""
+        raw = self.kv.get(self._lease_key(job_id))
+        if raw is None:
+            return None
+        jl = pb.JobLease()
+        jl.ParseFromString(raw)
+        return jl
+
+    def owns_job(self, job_id: str) -> bool:
+        return job_id in self._owned
+
+    def owned_jobs(self) -> List[str]:
+        return list(self._owned)
+
+    def renew_owned_leases(self) -> int:
+        """Heartbeat: extend every owned job lease by one TTL. A renewal
+        that finds the lease gone (expired, or a peer already claimed it)
+        just drops — the next fenced write settles ownership truthfully.
+        Returns how many leases were renewed."""
+        n = 0
+        for job_id in list(self._owned):
+            if self.kv.lease_renew(self._lease_key(job_id), self._lease_ttl):
+                n += 1
+        return n
+
+    def commit_plan_batch(self, job_id: str, items) -> None:
+        """Publish a planned job's stages/tasks/running-flip atomically AND
+        mint its ownership lease in the same batch (ISSUE 20): the lease is
+        born with the commit marker, so there is no committed job without
+        an owner and no owned job without a commit. The expect-absent CAS
+        on the lease key makes two replicas racing the same job id lose
+        cleanly (nothing from the loser's batch lands)."""
+        lk = self._lease_key(job_id)
+        self._lease_chaos()
+        value, gen_item = self._mint_lease_items(job_id)
+        ok = self.kv.put_all(
+            list(items) + [gen_item],
+            compare=(lk, None),
+            leases=[(lk, value, self._lease_ttl)],
+        )
+        if not ok:
+            raise RuntimeError(
+                f"job {job_id}: planning commit lost the lease race — "
+                "another replica already owns the job"
+            )
+        self._owned[job_id] = value
+        self._bump_plan_epoch()
+
+    def _fenced_put(self, job_id: str, key: str, value: bytes) -> bool:
+        """The single job-scoped durable write seam (ISSUE 20). Owned jobs
+        compare-and-swap against the remembered lease value: a mismatch
+        means a peer adopted the job — this replica is DEPOSED, drops its
+        ownership, and the write is REJECTED whole. An expired-but-
+        unclaimed lease is lazily re-minted (fresh fencing generation) in
+        the same batch: single-replica servers run no heartbeat thread, so
+        their leases routinely expire mid-job and must self-heal. Jobs this
+        replica never leased (hand-built test states, pre-ISSUE-20 rows)
+        write straight through, exactly as before replication."""
+        expected = self._owned.get(job_id)
+        if expected is None:
+            if job_id in self._deposed_jobs:
+                return False  # deposed: never degrade to unfenced writes
+            self.kv.put(key, value)
+            return True
+        lk = self._lease_key(job_id)
+        if self.kv.put_all([(key, value)], compare=(lk, expected)):
+            return True
+        if self.kv.get(lk) is None:
+            minted, gen_item = self._mint_lease_items(job_id)
+            if self.kv.put_all(
+                [(key, value), gen_item],
+                compare=(lk, None),
+                leases=[(lk, minted, self._lease_ttl)],
+            ):
+                self._owned[job_id] = minted
+                _record_recovery("lease_reminted")
+                return True
+        self._deposed(job_id)
+        return False
+
+    def _deposed(self, job_id: str) -> None:
+        """A peer's lease fenced out our write: drop ownership and every
+        in-memory claim on the job. The DURABLE rows (assignment and
+        speculation ledgers, statuses) now belong to the adopter — they are
+        read here only to size the handoff, never deleted: the adopter's
+        scoped recovery already reloaded them."""
+        self._owned.pop(job_id, None)
+        self._deposed_jobs.add(job_id)
+        self.fence_rejected += 1
+        _record_recovery("fence_rejected")
+        holder = self.job_lease(job_id)
+        handed_over = len(
+            self.kv.get_prefix(self._key("assignments", job_id) + "/")
+        ) + len(self.kv.get_prefix(self._key("speculation", job_id) + "/"))
+        for key in [k for k in self._assigned if k[0] == job_id]:
+            self._assigned.pop(key, None)
+        for key in [k for k in self._speculative if k[0] == job_id]:
+            self._speculative.pop(key, None)
+            self._spec_launches.pop(key, None)
+            self._spec_superseded.pop(key, None)
+        for key in [k for k in self._running_since if k[0] == job_id]:
+            self._running_since.pop(key, None)
+        log.warning(
+            "job %s: write fenced out — adopted by replica %r at %r "
+            "(%d durable ledger entries handed over)",
+            job_id,
+            holder.replica_id if holder is not None else "?",
+            holder.addr if holder is not None else "?",
+            handed_over,
+        )
+
+    def adopt_job(self, job_id: str) -> bool:
+        """Claim an expired job lease and run failover recovery scoped to
+        the job (ISSUE 20): failover IS restart recovery run by a peer —
+        the assignment/speculation ledgers reload with a fresh grace
+        window, executors' running echoes re-adopt what still runs, and
+        `restart_generation` stays untouched (no process died). Returns
+        False when a peer won the claim race."""
+        if job_id in self._owned:
+            return True
+        lk = self._lease_key(job_id)
+        self._lease_chaos()
+        minted, gen_item = self._mint_lease_items(job_id)
+        if not self.kv.put_all(
+            [gen_item], compare=(lk, None),
+            leases=[(lk, minted, self._lease_ttl)],
+        ):
+            return False
+        self._owned[job_id] = minted
+        self._deposed_jobs.discard(job_id)
+        _record_recovery("lease_adopted")
+        self.recover(jobs={job_id})
+        return True
+
+    def _may_schedule(self, job_id: str) -> bool:
+        """Ownership gate for the dispatch path: this replica schedules a
+        job iff it holds (or can claim) the job's lease. Adopt-on-demand is
+        the thread-free half of failover: any replica asked for work on a
+        job whose owner's lease expired picks the job up on the spot."""
+        if job_id in self._owned:
+            return True
+        if self.kv.get(self._lease_key(job_id)) is not None:
+            return False  # a live peer owns it
+        if self.kv.get(self._leasegen_key(job_id)) is None:
+            return True  # never leased: legacy/hand-built state
+        return self.adopt_job(job_id)
+
+    def ensure_job_writable(self, job_id: str) -> Optional[pb.JobLease]:
+        """Server admission gate: None when this replica may host work for
+        the job (owned, adopted on the spot, or never leased), else the
+        live FOREIGN lease carrying the owner's address to redirect to.
+        Bounded retry: a foreign lease expiring between the two reads
+        makes the job adoptable — loop back instead of returning a stale
+        verdict either way."""
+        for _ in range(3):
+            if self._may_schedule(job_id):
+                return None
+            lease = self.job_lease(job_id)
+            if lease is not None:
+                return lease
+        return None  # repeated expiry races: treat as writable (legacy path)
+
+    def replica_heartbeat(self) -> None:
+        """Renew (or re-grant) this replica's liveness key. The queued-
+        grace sweep on PEERS reads it: a queued job whose submitting
+        replica's heartbeat lapsed has no planner left to commit it."""
+        if not self.replica_id:
+            return
+        k = self._key("replicas", self.replica_id)
+        if not self.kv.lease_renew(k, self._lease_ttl):
+            self.kv.lease_grant(k, self.replica_id.encode(), self._lease_ttl)
+
+    def replica_alive(self, replica_id: str) -> bool:
+        return self.kv.get(self._key("replicas", replica_id)) is not None
+
+    def mark_job_planner(self, job_id: str) -> None:
+        """Stamp queued-grace provenance on a freshly accepted submission:
+        which replica owes this job its planning commit. Anonymous
+        (single-replica) servers skip it — their restart recovery already
+        sweeps torn queued jobs."""
+        if self.replica_id:
+            self.kv.put(
+                self._key("planner", job_id), self.replica_id.encode()
+            )
+
+    def job_planner(self, job_id: str) -> Optional[str]:
+        raw = self.kv.get(self._key("planner", job_id))
+        return raw.decode() if raw is not None else None
+
+    def _bump_plan_epoch(self) -> None:
+        """Advance the durable task-set epoch (ISSUE 20): the derived task
+        index used to be fresh by construction (single scheduler observes
+        its own writes); with peers mutating the same namespace,
+        _ensure_task_index re-seeds when the epoch it last saw moved. The
+        wall-clock reseed stays as the backstop for non-epoch drift."""
+        k = self._key("meta", "plan_epoch")
+        prior = self.kv.get(k)
+        nxt = (int(prior) if prior else 0) + 1
+        self.kv.put(k, str(nxt).encode())
+        self._plan_epoch_seen = nxt
+
+    def _bump_rc_epoch(self) -> None:
+        """Advance the durable result-cache epoch: peers re-derive their
+        entry count (a capacity input, not truth) after any delete."""
+        k = self._key("meta", "rc_epoch")
+        prior = self.kv.get(k)
+        nxt = (int(prior) if prior else 0) + 1
+        self.kv.put(k, str(nxt).encode())
+        self._rc_epoch_seen = nxt
+
+    def _reclaim_lease(self, job_id: str, raw) -> bool:
+        """Restart path: re-mint the lease a predecessor with OUR replica
+        id held — CAS against its exact surviving value, or expect-absent
+        when it already expired. Jobs never leased at all (pre-ISSUE-20
+        rows, hand-built test states) are reclaimed as unleased legacy
+        jobs. False = a peer claimed the job meanwhile."""
+        lk = self._lease_key(job_id)
+        if raw is None and self.kv.get(self._leasegen_key(job_id)) is None:
+            return True
+        minted, gen_item = self._mint_lease_items(job_id)
+        if self.kv.put_all(
+            [gen_item],
+            compare=(lk, raw),
+            leases=[(lk, minted, self._lease_ttl)],
+        ):
+            self._owned[job_id] = minted
+            return True
+        return False
+
+    def _restore_ledger_rows(self, rows, now: float, bump) -> None:
+        """Reload surviving assignment-ledger rows with a FRESH grace
+        window (restart and failover share this): entries whose KV task
+        status no longer matches (resolved or superseded before the owner
+        died) are dropped; the rest wait for their owner's running_echo."""
+        for k, v in rows:
+            tail = k.rsplit("/", 3)
+            key = (tail[1], int(tail[2]), int(tail[3]))
+            a = pb.Assignment()
+            a.ParseFromString(v)
+            cur = self.get_task_status(*key)
+            if (
+                cur is None
+                or cur.WhichOneof("status") != "running"
+                or cur.attempt != a.attempt
+                or cur.running.executor_id != a.executor_id
+            ):
+                # resolved or superseded before the crash; drop the entry
+                self.kv.delete(self._ledger_key(key))
+                continue
+            self._assigned[key] = (a.executor_id, a.attempt, now, True)
+            bump("restart_assignment_restored")
+
+    def _restore_spec_rows(self, rows, now: float, bump) -> None:
+        """Reload surviving speculation-ledger rows (ISSUE 11): a duplicate
+        is valid while the primary is still RUNNING at a LOWER attempt
+        (exactly attempt-1 for a single speculation; further behind after
+        re-speculation, ISSUE 15) — the pair's completions then resolve
+        through the normal first-completion-wins path. Anything else is a
+        leftover record to sweep."""
+        for k, v in rows:
+            tail = k.rsplit("/", 3)
+            key = (tail[1], int(tail[2]), int(tail[3]))
+            a = pb.Assignment()
+            a.ParseFromString(v)
+            cur = self.get_task_status(*key)
+            if (
+                cur is None
+                or cur.WhichOneof("status") != "running"
+                or cur.attempt >= a.attempt
+            ):
+                self.kv.delete(self._spec_key(key))
+                continue
+            self._speculative[key] = (a.executor_id, a.attempt, now, False, True)
+            # rebuild the launch bound from attempt arithmetic (the
+            # superseded set died with the old process; the requeue
+            # numbering floor covers its late reports regardless)
+            self._spec_launches[key] = max(1, a.attempt - cur.attempt)
+            _record_speculation("restored")
+            bump("restart_speculation_restored")
+
+    def recover(self, jobs=None) -> Dict[str, int]:
+        """Scheduler-restart recovery — and, scoped by `jobs`, peer
+        FAILOVER (ISSUE 20: adopting a dead replica's jobs runs exactly
+        this, restricted to them, with no generation bump — no process
+        died, the store's restart count is unchanged).
+
+        Full mode (jobs=None), called once before serving (the caller
+        holds no lock yet — nothing else can touch this state):
+
+        - A job still QUEUED was never committed: planning publishes
+          stages, tasks, and the `running` flip in ONE atomic put_all, and
+          the logical plan lived only in the dead scheduler's memory — so
+          the job is failed cleanly ("resubmit") instead of hanging the
+          client forever. With live PEER leases in the namespace the
+          queued job may be a peer's in-flight planning, so it is left
+          alone — the housekeeping queued-grace sweep fails truly
+          abandoned ones after a couple of lease TTLs.
+        - RUNNING jobs owned by a LIVE peer lease are skipped entirely
+          (theirs to run); our own surviving or expired leases are
+          re-minted with a fresh fencing generation.
         - The assignment ledger reloads with a FRESH grace window: entries
-          whose KV task status no longer matches (resolved or superseded
-          before the crash) are dropped; the rest wait for their owner's
-          running_echo — re-adopted on the first vouching poll, requeued
-          through the normal retry path if nobody vouches in time.
+          whose KV task status no longer matches are dropped; the rest
+          wait for their owner's running_echo — re-adopted on the first
+          vouching poll, requeued through the normal retry path if nobody
+          vouches in time.
 
         Returns the recovery counters (also fed into ops.runtime so
         bench.py's `recovery` field picks them up). A fresh store returns
         {} without recording anything."""
-        jobs = list(self.kv.get_prefix(self._key("jobs")))
-        ledger = list(self.kv.get_prefix(self._key("assignments")))
-        spec_ledger = list(self.kv.get_prefix(self._key("speculation")))
-        if not jobs and not ledger and not spec_ledger:
-            return {}
         stats: Dict[str, int] = {}
 
         def bump(event: str) -> None:
             _record_recovery(event)
             stats[event] = stats.get(event, 0) + 1
 
+        now = time.monotonic()
+        if jobs is not None:
+            # scoped failover: adopt exactly these (already re-leased) jobs
+            for job_id in sorted(jobs):
+                js = self.get_job_metadata(job_id)
+                if js is None or js.WhichOneof("status") != "running":
+                    continue
+                bump("restart_job_resumed")
+                self._restore_ledger_rows(
+                    list(self.kv.get_prefix(self._key("assignments", job_id) + "/")),
+                    now, bump,
+                )
+                self._restore_spec_rows(
+                    list(self.kv.get_prefix(self._key("speculation", job_id) + "/")),
+                    now, bump,
+                )
+                self._job_tenant_full(job_id)
+            # adopted tasks enter this replica's (and every peer's) task
+            # index through the epoch read-through, not a private reseed
+            self._bump_plan_epoch()
+            if stats:
+                log.warning("failover adoption recovery: %s", stats)
+            return stats
+        job_rows = list(self.kv.get_prefix(self._key("jobs")))
+        ledger = list(self.kv.get_prefix(self._key("assignments")))
+        spec_ledger = list(self.kv.get_prefix(self._key("speculation")))
+        if not job_rows and not ledger and not spec_ledger:
+            return {}
         bump("scheduler_restart")
         gen_key = self._key("meta", "restart_generation")
         prior = self.kv.get(gen_key)
         self.generation = (int(prior) if prior else 0) + 1
         self.kv.put(gen_key, str(self.generation).encode())
+        lease_rows: Dict[str, bytes] = {
+            k.rsplit("/", 1)[1]: v
+            for k, v in self.kv.get_prefix(self._key("leases"))
+        }
+        peers_alive = False
+        for raw in lease_rows.values():
+            jl = pb.JobLease()
+            jl.ParseFromString(raw)
+            if jl.replica_id != self.replica_id:
+                peers_alive = True
+                break
         running_jobs: List[str] = []
-        for k, v in jobs:
+        foreign: set = set()
+        for k, v in job_rows:
             job_id = k.rsplit("/", 1)[1]
             js = pb.JobStatus()
             js.ParseFromString(v)
             w = js.WhichOneof("status")
             if w == "queued":
+                if peers_alive:
+                    # plausibly a live peer's planning in flight; the
+                    # housekeeping queued-grace sweep owns the verdict
+                    continue
                 failed = pb.JobStatus()
                 failed.failed.error = (
                     "scheduler restarted before planning committed; the job "
@@ -590,53 +1011,26 @@ class SchedulerState:
                 bump("torn_job_discarded")
                 log.warning("discarded torn (uncommitted) job %s", job_id)
             elif w == "running":
-                running_jobs.append(job_id)
-                bump("restart_job_resumed")
-        now = time.monotonic()
-        for k, v in ledger:
-            tail = k.rsplit("/", 3)
-            key = (tail[1], int(tail[2]), int(tail[3]))
-            a = pb.Assignment()
-            a.ParseFromString(v)
-            cur = self.get_task_status(*key)
-            if (
-                cur is None
-                or cur.WhichOneof("status") != "running"
-                or cur.attempt != a.attempt
-                or cur.running.executor_id != a.executor_id
-            ):
-                # resolved or superseded before the crash; drop the entry
-                self.kv.delete(k)
-                continue
-            self._assigned[key] = (a.executor_id, a.attempt, now, True)
-            bump("restart_assignment_restored")
-        for k, v in spec_ledger:
-            # speculative duplicates (ISSUE 11): valid while the primary is
-            # still RUNNING at a LOWER attempt (exactly attempt-1 for a
-            # single speculation; further behind after re-speculation,
-            # ISSUE 15) — the pair's completions then resolve through the
-            # normal first-completion-wins path. Anything else (primary
-            # resolved, requeued, or the pair already settled) is a
-            # leftover record to sweep.
-            tail = k.rsplit("/", 3)
-            key = (tail[1], int(tail[2]), int(tail[3]))
-            a = pb.Assignment()
-            a.ParseFromString(v)
-            cur = self.get_task_status(*key)
-            if (
-                cur is None
-                or cur.WhichOneof("status") != "running"
-                or cur.attempt >= a.attempt
-            ):
-                self.kv.delete(k)
-                continue
-            self._speculative[key] = (a.executor_id, a.attempt, now, False, True)
-            # rebuild the launch bound from attempt arithmetic (the
-            # superseded set died with the old process; the requeue
-            # numbering floor covers its late reports regardless)
-            self._spec_launches[key] = max(1, a.attempt - cur.attempt)
-            _record_speculation("restored")
-            bump("restart_speculation_restored")
+                raw = lease_rows.get(job_id)
+                if raw is not None:
+                    jl = pb.JobLease()
+                    jl.ParseFromString(raw)
+                    if jl.replica_id != self.replica_id:
+                        foreign.add(job_id)  # a live peer's job; not ours
+                        continue
+                if self._reclaim_lease(job_id, raw):
+                    running_jobs.append(job_id)
+                    bump("restart_job_resumed")
+                else:
+                    foreign.add(job_id)  # a peer claimed it meanwhile
+        self._restore_ledger_rows(
+            [(k, v) for k, v in ledger if k.rsplit("/", 3)[1] not in foreign],
+            now, bump,
+        )
+        self._restore_spec_rows(
+            [(k, v) for k, v in spec_ledger if k.rsplit("/", 3)[1] not in foreign],
+            now, bump,
+        )
         # warm every derived structure from KV truth before serving
         # (ISSUE 18: each derived(<rebuild-fn>) classification promises its
         # rebuild is reachable from here — the durability analyzer checks
@@ -678,9 +1072,16 @@ class SchedulerState:
         return m
 
     # -- jobs -----------------------------------------------------------------
-    def save_job_metadata(self, job_id: str, status: pb.JobStatus) -> None:
-        self.kv.put(self._key("jobs", job_id), status.SerializeToString())
+    def save_job_metadata(self, job_id: str, status: pb.JobStatus) -> bool:
+        """Write the job status, fenced by the ownership lease (ISSUE 20).
+        False = a peer adopted the job and the write was rejected whole;
+        subscribers are only notified of writes that actually landed."""
+        if not self._fenced_put(
+            job_id, self._key("jobs", job_id), status.SerializeToString()
+        ):
+            return False
         self._notify_job_status(job_id, status)
+        return True
 
     def get_job_metadata(self, job_id: str) -> Optional[pb.JobStatus]:
         v = self.kv.get(self._key("jobs", job_id))
@@ -845,7 +1246,17 @@ class SchedulerState:
         authoritative prefix scan (idempotent; the at-cap eviction path
         re-derives it). The derived(_ensure_rc_count) rebuild recover()
         runs so a restarted replica starts with a true count instead of
-        paying the seed scan on its first at-cap put."""
+        paying the seed scan on its first at-cap put.
+
+        Generation-stamped read-through (ISSUE 20): peers deleting entries
+        bump the durable rc epoch; seeing it move invalidates the cached
+        count, so the next capacity check re-derives instead of trusting a
+        figure a peer already made stale."""
+        epoch_raw = self.kv.get(self._key("meta", "rc_epoch"))
+        epoch = int(epoch_raw) if epoch_raw else 0
+        if self._rc_epoch_seen is not None and epoch != self._rc_epoch_seen:
+            self._rc_count = None
+        self._rc_epoch_seen = epoch
         if self._rc_count is None:
             self._rc_count = len(
                 self.kv.get_prefix(self._key("resultcache") + "/")
@@ -860,6 +1271,7 @@ class SchedulerState:
         self.kv.delete(key)
         if self._rc_count is not None:
             self._rc_count = max(0, self._rc_count - 1)
+        self._bump_rc_epoch()
 
     def _result_cache_evict_for(self, incoming_fp: str) -> int:
         """Make room for one incoming entry under the
@@ -907,6 +1319,7 @@ class SchedulerState:
         # authoritative re-derivation: surviving others + the incoming entry
         self._rc_count = (len(live) - evicted) + 1
         if evicted:
+            self._bump_rc_epoch()
             log.info("result cache evicted %d entries (cap %d)", evicted, cap)
         return evicted
 
@@ -1177,7 +1590,11 @@ class SchedulerState:
         return phys_plan_from_proto(n)
 
     # -- tasks ------------------------------------------------------------------
-    def save_task_status(self, status: pb.TaskStatus) -> None:
+    def save_task_status(self, status: pb.TaskStatus) -> bool:
+        """Write the task status, fenced by the job's ownership lease
+        (ISSUE 20). False = a peer adopted the job and the write was
+        rejected whole — the index observes only writes that landed (the
+        watch maps were already purged by the deposition)."""
         pid = status.partition_id
         key = self._key("tasks", pid.job_id, str(pid.stage_id), str(pid.partition_id))
         # maintain the running-task watch (ISSUE 11): the straggler monitor
@@ -1202,9 +1619,11 @@ class SchedulerState:
                 heapq.heappush(self._running_heap, (t0, key3))
         else:
             self._running_since.pop(key3, None)
-        self.kv.put(key, status.SerializeToString())
+        if not self._fenced_put(pid.job_id, key, status.SerializeToString()):
+            return False
         if self._task_index is not None:
             self._task_index.observe(status)
+        return True
 
     def accept_task_status(self, status: pb.TaskStatus) -> bool:
         """Gate for executor-reported statuses: drop stale reports from
@@ -1369,7 +1788,12 @@ class SchedulerState:
         if current is not None and current.history:
             merged.ClearField("history")
             merged.history.MergeFrom(current.history)
-        self.save_task_status(merged)
+        if not self.save_task_status(merged):
+            # fenced out (ISSUE 20): a peer adopted the job mid-report. The
+            # durable ledger rows below are the ADOPTER's now — bail before
+            # the deletes, and report the status as not-applied so the
+            # server never folds it into job synchronization.
+            return False
         if merged.WhichOneof("status") in ("completed", "failed", "fetch_failed"):
             # the assignment resolved; stop watching for orphaning
             self._ledger_del((pid.job_id, pid.stage_id, pid.partition_id))
@@ -1386,8 +1810,19 @@ class SchedulerState:
         TASK_INDEX_RESEED_SECS so peer-scheduler writes (new jobs, lost-task
         resets) are discovered with bounded delay instead of never.
         Assignment additionally re-verifies the chosen task's pending state
-        and every upstream status from the KV before acting on them."""
+        and every upstream status from the KV before acting on them.
+
+        Generation-stamped read-through (ISSUE 20): peer task-set mutations
+        (plan commits, failover adoptions) bump the durable plan epoch, and
+        seeing it move forces a reseed NOW instead of after the wall-clock
+        backstop — a replica's index lags a peer's commit by one epoch
+        read, not by up to TASK_INDEX_RESEED_SECS."""
         now = time.monotonic()
+        epoch_raw = self.kv.get(self._key("meta", "plan_epoch"))
+        epoch = int(epoch_raw) if epoch_raw else 0
+        if self._plan_epoch_seen is not None and epoch != self._plan_epoch_seen:
+            self._task_index = None
+        self._plan_epoch_seen = epoch
         if (
             self._task_index is None
             or now - self._task_index_seeded_at > TASK_INDEX_RESEED_SECS
@@ -1496,8 +1931,12 @@ class SchedulerState:
             h.executor_id = executor_id
             h.error = error
             promoted.running.executor_id = spec[0]
+            if not self.save_task_status(promoted):
+                # fenced out (ISSUE 20): the adopter owns the retry now —
+                # leave its durable ledger rows alone and report the task
+                # as handled (nothing for the caller to fail)
+                return True
             self._ledger_del(key3)  # superseded primary assignment
-            self.save_task_status(promoted)
             # the duplicate has been RUNNING since its launch, not since
             # this promotion — keep the watch clock honest (save_task_
             # status just re-stamped it with now) or its completion would
@@ -1540,10 +1979,6 @@ class SchedulerState:
         # (the abandoned ones included), so no late duplicate report can
         # impersonate it.
         floor = self._spec_attempt_floor(key3)
-        self._ledger_del((pid0.job_id, pid0.stage_id, pid0.partition_id))
-        if spec is not None:
-            _record_speculation("failed")
-        self._spec_resolve(key3)
         pending = pb.TaskStatus()
         pending.partition_id.CopyFrom(t.partition_id)
         pending.attempt = max(t.attempt, floor) + 1
@@ -1552,7 +1987,15 @@ class SchedulerState:
         h.attempt = t.attempt
         h.executor_id = executor_id
         h.error = error
-        self.save_task_status(pending)
+        # the fenced status write goes FIRST (ISSUE 20): a rejected write
+        # means a peer adopted the job, and its restored ledger rows must
+        # not be deleted by this (deposed) replica's cleanup below
+        if not self.save_task_status(pending):
+            return True
+        self._ledger_del((pid0.job_id, pid0.stage_id, pid0.partition_id))
+        if spec is not None:
+            _record_speculation("failed")
+        self._spec_resolve(key3)
         _record_recovery("task_retry")
         pid = t.partition_id
         log.warning(
@@ -1622,10 +2065,28 @@ class SchedulerState:
                 limits[job_id] = self.retry_limit(job_id)
             return limits[job_id]
 
+        touch_memo: Dict[str, bool] = {}
+
+        def may_touch(job_id: str) -> bool:
+            # ownership filter (ISSUE 20): leased jobs are reset by their
+            # owner — a live foreign lease means a peer's sweep covers it,
+            # an expired one means adoption (not this sweep) picks it up.
+            # Never-leased jobs keep the legacy single-scheduler behavior.
+            if job_id in self._owned:
+                return True
+            if job_id not in touch_memo:
+                touch_memo[job_id] = (
+                    self.kv.get(self._lease_key(job_id)) is None
+                    and self.kv.get(self._leasegen_key(job_id)) is None
+                )
+            return touch_memo[job_id]
+
         for t in self.get_all_tasks():
             job_id = t.partition_id.job_id
             if job_finished(job_id):
                 continue  # don't resurrect finished jobs
+            if not may_touch(job_id):
+                continue  # a peer replica's job (ISSUE 20)
             w = t.WhichOneof("status")
             owner = None
             if w == "running":
@@ -2304,7 +2765,8 @@ class SchedulerState:
             running = pb.TaskStatus()
             running.CopyFrom(current)  # keep attempt + history
             running.running.executor_id = executor_id
-            self.save_task_status(running)
+            if not self.save_task_status(running):
+                continue  # fenced out: a peer adopted the sibling's job
             self._ledger_put(
                 (job_id, stage_id, partition), executor_id, running.attempt
             )
@@ -2618,9 +3080,15 @@ class SchedulerState:
                 # flips the job to running with its tasks): tasks visible
                 # under a queued job can only be leakage from a torn write
                 # on a non-transactional backend and must not be handed out
-                job_live[job_id] = js is None or js.WhichOneof("status") not in (
-                    "completed", "failed", "queued",
-                )
+                job_live[job_id] = (
+                    js is None
+                    or js.WhichOneof("status") not in (
+                        "completed", "failed", "queued",
+                    )
+                    # ownership gate (ISSUE 20): only the lease holder hands
+                    # the job's tasks out — adopting on the spot when the
+                    # previous owner's lease expired (thread-free failover)
+                ) and self._may_schedule(job_id)
             if not job_live[job_id]:
                 continue
             bound = self._bound_stage_plan(job_id, stage_id, idx)
@@ -2670,7 +3138,12 @@ class SchedulerState:
                     from ballista_tpu.ops.runtime import record_exchange
 
                     record_exchange("locality_preferred")
-                self.save_task_status(running)
+                if not self.save_task_status(running):
+                    # fenced out mid-assignment (ISSUE 20): a peer adopted
+                    # the job between the liveness check and the claim —
+                    # nothing was written; stop offering this job's tasks
+                    job_live[job_id] = False
+                    break
                 self._ledger_put(
                     (job_id, stage_id, partition), executor_id, running.attempt
                 )
@@ -2713,6 +3186,8 @@ class SchedulerState:
         # window is simply dropped — the primary still runs, so there is
         # nothing to requeue.
         for key, entry in list(self._speculative.items()):
+            if key not in self._speculative:
+                continue  # purged mid-loop (deposition, ISSUE 20)
             ex, at, t0, vouched, restored = entry
             if ex != executor_id:
                 continue
@@ -2737,6 +3212,8 @@ class SchedulerState:
         # Entries of other owners (incl. ones superseded elsewhere) are
         # cleaned on their owner's polls or by accept_task_status.
         for key, (owner, attempt, t0, restored) in list(self._assigned.items()):
+            if key not in self._assigned:
+                continue  # purged mid-loop (deposition, ISSUE 20)
             if owner != executor_id:
                 continue  # only the owner's polls can vouch for it
             if key in echo and echo[key] in (None, attempt):
@@ -2753,6 +3230,15 @@ class SchedulerState:
                 continue
             if now - t0 < ORPHANED_ASSIGNMENT_GRACE_SECS:
                 continue
+            # destructive path ahead: re-verify the ownership lease first
+            # (ISSUE 20). A peer may have adopted the job while this
+            # replica sat paused past its TTL — its restored ledger rows
+            # must not be deleted by the deposed owner's reconciliation.
+            if key[0] in self._owned:
+                held = self.kv.get(self._lease_key(key[0]))
+                if held is not None and held != self._owned[key[0]]:
+                    self._deposed(key[0])
+                    continue
             cur = self.get_task_status(*key)
             if (
                 cur is None
@@ -2861,7 +3347,11 @@ class SchedulerState:
                 pl.partition_stats.CopyFrom(t.completed.stats)
                 pl.storage_uri = t.completed.storage_uri
                 pl.resident = t.completed.resident
-        self.save_job_metadata(job_id, status)
+        if not self.save_job_metadata(job_id, status):
+            # fenced out (ISSUE 20): a peer adopted the job mid-fold — its
+            # own synchronization owns the terminal transition, the GC
+            # release, the SLO note, and the result-cache publish
+            return
         which_new = status.WhichOneof("status")
         if which_new in ("completed", "failed"):
             # shared-store GC (ISSUE 16 satellite): the terminal transition

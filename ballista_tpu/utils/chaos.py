@@ -111,6 +111,27 @@ SITES = (
                              # planning path, so results stay bit-identical
                              # by construction (the fold is an accelerator,
                              # never the only correct path).
+    "scheduler.lease",       # ownership-lease heartbeat renewal (ISSUE 20,
+                             # scheduler/server.py housekeeping): a torn
+                             # renewal round skips renewing this replica's
+                             # job leases, rehearsing a stalled heartbeat —
+                             # the leases may expire and a peer may adopt
+                             # the jobs mid-flight. Safe BY FENCING: the
+                             # deposed owner's later writes carry the stale
+                             # lease value and are rejected by the CAS in
+                             # put_all, so a spurious expiry costs at most
+                             # an ownership migration, never corruption.
+                             # Keyed on a generation-rotated per-process
+                             # renewal-round sequence (g{gen}/renew{n}).
+    "kv.lease",              # lease write/renew KV op (ISSUE 20,
+                             # scheduler/state.py lease mint + renewal
+                             # seam): the op itself fails as if the store
+                             # dropped the request — a torn MINT aborts the
+                             # planning commit (retried like kv.put), a
+                             # torn RENEWAL is indistinguishable from
+                             # scheduler.lease's stalled round. Keyed like
+                             # kv.put on a generation-rotated per-process
+                             # op sequence.
     "task.slow",             # deterministic straggler injection (ISSUE 11,
                              # execution_loop.py): a task whose (stage,
                              # partition, attempt) coordinate draws a slow

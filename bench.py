@@ -1867,6 +1867,144 @@ def _routing_scenario() -> dict | None:
     return routing
 
 
+def _replica_client_proc(endpoints, home, table, settings, qlist, idx,
+                         duration, out_q) -> None:
+    """One closed-loop admission client homed to replica ``home`` (peer
+    endpoints armed for redirect/failover). Buffered-collects every query
+    and content-hashes the result so the parent can assert bit-identity
+    across replica counts without shipping tables."""
+    try:
+        import hashlib
+
+        from ballista_tpu.client import BallistaContext
+
+        host, port = endpoints[home]
+        ctx = BallistaContext(host, port, settings=settings,
+                              endpoints=endpoints[home:] + endpoints[:home])
+        ctx.register_record_batches("t", table, n_partitions=4)
+        digests = set()
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < duration:
+            sql = qlist[(idx + n) % len(qlist)]
+            n += 1
+            tbl = ctx.sql(sql).collect()
+            digests.add(
+                hashlib.sha256(repr(tbl.to_pydict()).encode()).hexdigest()
+            )
+        wall = time.perf_counter() - t0
+        ctx.close()
+        out_q.put(("ok", idx, n, wall, sorted(digests)))
+    except Exception as e:
+        out_q.put(("error", idx, repr(e)))
+
+
+def _replica_scenario() -> dict | None:
+    """Replicated control plane scenario (ISSUE 20): closed-loop admission
+    against ONE process-local cluster run two ways — a single scheduler,
+    then two lease-sharded replicas over the same KV store. C client
+    processes (homed round-robin across the replicas, peers armed for
+    ownership redirects) submit-and-collect a fixed aggregation workload
+    for a fixed window. Reports per-config completed-query QPS and asserts
+    the UNION of result digests is identical across configs, so the
+    throughput comparison can never ride a correctness regression.
+
+    Knobs: BENCH_REPLICA_DURATION (default 4 s), BENCH_REPLICA_CLIENTS
+    (default 4), BENCH_REPLICA_ROWS (default 40000)."""
+    import multiprocessing as mp
+
+    import numpy as np
+    import pyarrow as pa
+
+    from ballista_tpu.executor.runtime import StandaloneCluster
+
+    duration = float(os.environ.get("BENCH_REPLICA_DURATION", "4"))
+    clients = int(os.environ.get("BENCH_REPLICA_CLIENTS", "4"))
+    n_rows = int(os.environ.get("BENCH_REPLICA_ROWS", "40000"))
+    rng = np.random.default_rng(20)
+    table = pa.table({
+        "g": pa.array(rng.integers(0, 40, n_rows), type=pa.int64()),
+        "v": pa.array(np.round(rng.uniform(-100, 100, n_rows), 2)),
+        "q": pa.array(rng.integers(1, 50, n_rows), type=pa.int64()),
+        "s": pa.array([f"t{x}" for x in rng.integers(0, 5, n_rows)]),
+    })
+    settings = {"ballista.shuffle.partitions": "4"}
+    qlist = [
+        "select g, sum(v) as s, count(*) as n from t group by g order by g",
+        "select s, min(q) as mn, max(q) as mx from t group by s order by s",
+        "select g, sum(q) as sq from t where v > 0 group by g order by g",
+        "select s, count(*) as n from t where q < 30 group by s order by s",
+        "select g, s, sum(v) as sv from t group by g, s order by g, s",
+        "select s, sum(v) as sv, sum(q) as sq from t group by s order by s",
+    ]
+
+    def run(n_schedulers: int):
+        cluster = StandaloneCluster(n_executors=2, n_schedulers=n_schedulers)
+        try:
+            endpoints = [("127.0.0.1", p) for p in cluster.ports]
+            mpctx = mp.get_context("spawn")
+            out_q = mpctx.Queue()
+            procs = [
+                mpctx.Process(
+                    target=_replica_client_proc,
+                    args=(endpoints, i % n_schedulers, table, settings,
+                          qlist, i, duration, out_q),
+                    daemon=True,
+                )
+                for i in range(clients)
+            ]
+            for p in procs:
+                p.start()
+            qps, digests, errors = 0.0, set(), []
+            got = 0
+            deadline = time.monotonic() + duration + 240
+            while got < clients and time.monotonic() < deadline:
+                try:
+                    msg = out_q.get(
+                        timeout=max(0.1, deadline - time.monotonic())
+                    )
+                except Exception:
+                    break
+                got += 1
+                if msg[0] == "error":
+                    errors.append(f"client{msg[1]}: {msg[2]}")
+                    continue
+                _tag, _idx, n, wall, ds = msg
+                qps += n / max(wall, 1e-9)
+                digests.update(ds)
+            for p in procs:
+                p.join(10)
+                if p.is_alive():
+                    errors.append("client process still running; terminated")
+                    p.terminate()
+            if got < clients and not errors:
+                errors.append(f"only {got}/{clients} clients reported")
+            if errors:
+                raise RuntimeError(str(errors))
+            return qps, digests
+        finally:
+            cluster.shutdown()
+
+    one_qps, one_digests = run(1)
+    two_qps, two_digests = run(2)
+    result = {
+        "rows": n_rows,
+        "clients": clients,
+        "duration_s": duration,
+        "one": {"schedulers": 1, "qps": round(one_qps, 2)},
+        "two": {"schedulers": 2, "qps": round(two_qps, 2)},
+        "speedup": round(two_qps / max(one_qps, 1e-9), 3),
+        "digests_identical": one_digests == two_digests,
+        "n_digests": len(one_digests),
+    }
+    print(f"[replica] 1-replica={result['one']['qps']}qps "
+          f"2-replica={result['two']['qps']}qps "
+          f"speedup={result['speedup']} "
+          f"digests_identical={result['digests_identical']}",
+          file=sys.stderr)
+    return result
+
+
 def main() -> None:
     if os.environ.get("BENCH_ROUTING_ONLY"):
         # adaptive-execution smoke only: runs without a reachable device
@@ -1899,6 +2037,10 @@ def main() -> None:
     if os.environ.get("BENCH_DELTA_ONLY"):
         # incremental-execution scenario only: runs without a reachable device
         print(json.dumps({"delta": _delta_scenario()}))
+        return
+    if os.environ.get("BENCH_REPLICA_ONLY"):
+        # replicated control-plane scenario only: runs without a device
+        print(json.dumps({"replica": _replica_scenario()}))
         return
     _probe_device()
     ensure_data(SF)

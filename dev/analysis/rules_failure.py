@@ -47,7 +47,7 @@ _DEFAULT_SITES = frozenset(
         "executor.death", "scheduler.plan_write", "scheduler.crash",
         "cache.put", "scheduler.admit", "scheduler.push", "aot.load",
         "scheduler.batch", "task.slow", "shuffle.store", "fleet.scale",
-        "exchange.evict", "cache.advance",
+        "exchange.evict", "cache.advance", "scheduler.lease", "kv.lease",
     }
 )
 

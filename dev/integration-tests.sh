@@ -446,6 +446,27 @@ print("delta smoke OK:",
        "advance_hits": rec["advance_hits"], "digest": rec["digest"]})
 PY
 
+# replicated control-plane bench smoke (ISSUE 20): closed-loop admission
+# from 4 client processes, homed round-robin, against one scheduler and
+# then two lease-sharded replicas over the same KV. Two replicas must
+# admit strictly more completed queries per second, and the union of
+# result digests must be IDENTICAL across both configs — the throughput
+# win never rides a correctness regression.
+JAX_PLATFORMS=cpu BENCH_REPLICA_ONLY=1 BENCH_REPLICA_DURATION=4 \
+    python bench.py > /tmp/_ballista_replica_smoke.json
+python - /tmp/_ballista_replica_smoke.json <<'PY'
+import json, sys
+rec = json.load(open(sys.argv[1]))["replica"]
+assert rec is not None, "replica scenario returned no record"
+assert rec["digests_identical"], "replicated admission changed results"
+assert rec["n_digests"] >= 1, rec
+assert rec["two"]["qps"] > rec["one"]["qps"], (
+    f"2-replica admission not faster than 1-replica: {rec}")
+print("replica smoke OK:",
+      {"one_qps": rec["one"]["qps"], "two_qps": rec["two"]["qps"],
+       "speedup": rec["speedup"], "n_digests": rec["n_digests"]})
+PY
+
 # full tier-1 under the dynamic lock witness (ISSUE 16 satellite): every
 # fast test — the exchange registry, scheduler GC, chaos ladders, SPMD
 # admission included — runs with each project lock asserting the declared

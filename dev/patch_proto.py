@@ -377,6 +377,30 @@ def edit_issue19_delta(fdp) -> None:
     add_field(rc, "advance_epoch", 8, U32)
 
 
+def edit_issue20_replication(fdp) -> None:
+    """ISSUE 20: replicated control plane (lease-sharded scheduler replicas).
+
+    Adds (all wire-compatible field/message additions):
+    - JobLease message: the durable leases/{job} ownership record — which
+      replica owns the job (replica_id), the fencing generation (fence,
+      bumped on every ownership transfer so a deposed owner's remembered
+      lease value can never match again), and the owner's advertised
+      host:port (addr) for client/executor redirects. Minted atomically
+      with the planning commit; TTL-renewed by the owner's heartbeat.
+    - GetJobStatusResult.owner_addr: non-empty when the serving replica is
+      NOT the job's owner — the owner's host:port, so a client can re-home
+      its push subscription (and an executor its poll) after a failover.
+    """
+    lease = fdp.message_type.add()
+    lease.name = "JobLease"
+    add_field(lease, "replica_id", 1, STR)
+    add_field(lease, "fence", 2, U32)
+    add_field(lease, "addr", 3, STR)
+
+    msgs = {m.name: m for m in fdp.message_type}
+    add_field(msgs["GetJobStatusResult"], "owner_addr", 2, STR)
+
+
 # edits already baked into the checked-in ballista_pb2.py, oldest first
 APPLIED = [
     edit_issue5_failure_recovery,
@@ -389,6 +413,7 @@ APPLIED = [
     edit_issue15_disaggregated_shuffle,
     edit_issue16_resident_exchange,
     edit_issue19_delta,
+    edit_issue20_replication,
 ]
 
 

@@ -38,15 +38,51 @@ def reset() -> None:
 
 
 class _Lease:
-    def __init__(self, ttl: int) -> None:
+    def __init__(self, ttl: int, server: Optional[_Server] = None) -> None:
         if ttl < 1:
             raise ValueError("etcd lease TTL must be >= 1 second")
         self.ttl = ttl
+        # keys attached to this lease (put(..., lease=)); refresh() extends
+        # their expiry like etcd's lease keepalive does
+        self._server = server
+        self._keys: set = set()
+
+    def refresh(self):
+        if self._server is None:
+            return []
+        with self._server.mu:
+            now = time.time()
+            for k in list(self._keys):
+                item = self._server.data.get(k)
+                if item is None:
+                    continue
+                value, expires = item
+                if expires is not None and now > expires:
+                    continue
+                self._server.data[k] = (value, now + self.ttl)
+        return [self]
 
 
 class _Meta:
     def __init__(self, key: str) -> None:
         self.key = key.encode()
+
+
+class _Compare:
+    """etcd3 compare builder: `transactions.value(k) == b"x"` yields the
+    compare object itself with the expectation recorded (the real library
+    overloads __eq__ the same way)."""
+
+    def __init__(self, kind: str, key: str) -> None:
+        self.kind = kind
+        self.key = key
+        self.expected: object = NotImplemented
+
+    def __eq__(self, other):  # type: ignore[override]
+        self.expected = other
+        return self
+
+    __hash__ = None  # compare builders are not hashable, like the real ones
 
 
 class _Client:
@@ -88,6 +124,8 @@ class _Client:
             value = value.encode()
         with self._server.mu:
             expires = time.time() + lease.ttl if lease is not None else None
+            if lease is not None:
+                lease._keys.add(key)
             self._server.data[key] = (bytes(value), expires)
 
     def delete(self, key: str) -> None:
@@ -102,29 +140,57 @@ class _Client:
     # -- transactions ----------------------------------------------------
     @property
     def transactions(self):
-        """etcd3's client.transactions op-builder namespace; only `put` is
-        modeled (EtcdBackend.put_all builds unconditional success puts)."""
+        """etcd3's client.transactions op-builder namespace: success puts
+        (lease-bearing included) plus the two compare shapes EtcdBackend's
+        fenced put_all builds — `value(key) == expected` and
+        `version(key) == 0` (expect-absent)."""
         class _Txns:
             @staticmethod
             def put(key, value, lease=None):
-                return ("put", key, value)
+                return ("put", key, value, lease)
+
+            @staticmethod
+            def value(key):
+                return _Compare("value", key)
+
+            @staticmethod
+            def version(key):
+                return _Compare("version", key)
 
         return _Txns()
 
     def transaction(self, compare, success, failure):
-        if compare or failure:
-            raise NotImplementedError("fake etcd3 models compare-less txns only")
+        if failure:
+            raise NotImplementedError("fake etcd3 models empty failure branches only")
         with self._server.mu:
-            for op, key, value in success:
+            for c in compare:
+                if not isinstance(c, _Compare) or c.expected is NotImplemented:
+                    raise NotImplementedError(
+                        "fake etcd3 models value/version == compares only"
+                    )
+                live = self._live(c.key)
+                if c.kind == "value":
+                    expected = c.expected
+                    if isinstance(expected, str):
+                        expected = expected.encode()
+                    if live != expected:
+                        return (False, [])
+                else:  # version: 0 = absent, >=1 = present
+                    if (0 if live is None else 1) != c.expected:
+                        return (False, [])
+            for op, key, value, lease in success:
                 assert op == "put"
                 if isinstance(value, str):
                     value = value.encode()
-                self._server.data[key] = (bytes(value), None)
+                expires = time.time() + lease.ttl if lease is not None else None
+                if lease is not None:
+                    lease._keys.add(key)
+                self._server.data[key] = (bytes(value), expires)
         return (True, [])
 
     # -- lease / lock ---------------------------------------------------
     def lease(self, ttl: int) -> _Lease:
-        return _Lease(int(ttl))
+        return _Lease(int(ttl), self._server)
 
     @contextlib.contextmanager
     def lock(self, name: str):

@@ -142,6 +142,17 @@ COMPARATORS = {
     "_spec_launches": lambda ctl, rec, jobs: (
         rec._spec_launches == ctl._spec_launches
     ),
+    # ISSUE 20 generation-stamped read-throughs: recovery must leave the
+    # replica tracking the SAME durable epoch the control sees, so the
+    # next peer mutation (an epoch bump) re-derives the cached view
+    "_plan_epoch_seen": lambda ctl, rec, jobs: (
+        ctl._ensure_task_index() is not None
+        and rec._plan_epoch_seen == ctl._plan_epoch_seen
+    ),
+    "_rc_epoch_seen": lambda ctl, rec, jobs: (
+        ctl._ensure_rc_count() is not None
+        and rec._rc_epoch_seen == ctl._rc_epoch_seen
+    ),
 }
 
 

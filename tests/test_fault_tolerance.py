@@ -382,8 +382,10 @@ def _client_with_stub(stub, retries=3):
 
     c = SchedulerGrpcClient("127.0.0.1", 1, channel=grpc.insecure_channel(
         "127.0.0.1:1"), retries=retries, backoff_s=0.0)
-    c._stubs["PollWork"] = stub
-    c._stubs["GetFileMetadata"] = stub
+    # stub cache is keyed (endpoint_idx, method) since ISSUE 20; one
+    # configured endpoint means every call resolves through index 0
+    c._stub_cache[(0, "PollWork")] = stub
+    c._stub_cache[(0, "GetFileMetadata")] = stub
     return c
 
 

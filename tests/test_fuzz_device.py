@@ -1001,3 +1001,76 @@ def test_fuzz_delta_append(tmp_path, seed):
         assert g.equals(t), (sql, g.to_pydict(), t.to_pydict())
     assert stats.get("advance_hits", 0) == 0, stats
     assert stats.get("advance_declined", 0) >= 1, stats
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_fuzz_replica_failover(seed):
+    """ROADMAP fuzzer slice (ISSUE 20 satellite): random 2-stage plans
+    against a 2-replica control plane with the ``scheduler.lease`` chaos
+    site armed (torn renewal rounds lapse owned leases early, so peers
+    adopt live jobs) PLUS a seeded hard kill of replica 0 partway through
+    the query stream. Every query must come back BIT-IDENTICAL to the
+    fault-free single-scheduler oracle. Chaos verdicts on renewal rounds
+    are timing-dependent (rounds tick on the wall clock), so this slice
+    asserts results, not injection counters — the deterministic owner
+    kill is the headline. Own rng streams (30000+ data, 31000+ queries),
+    so every baseline stream above stays byte-identical."""
+    from ballista_tpu.client import BallistaContext
+    from ballista_tpu.config import BallistaConfig
+    from ballista_tpu.executor.runtime import StandaloneCluster
+    from ballista_tpu.ops.runtime import recovery_stats
+
+    rng = np.random.default_rng(30000 + seed)
+    qrng = np.random.default_rng(31000 + seed)
+    _fresh()
+    n = int(rng.integers(2_000, 6_000))
+    table = pa.table(
+        {
+            "g": pa.array(rng.integers(0, 50, n), type=pa.int64()),
+            "v": pa.array(np.round(rng.uniform(-100, 100, n), 2)),
+            "q": pa.array(rng.integers(1, 50, n), type=pa.int64()),
+            "s": pa.array([f"t{x}" for x in rng.integers(0, 5, n)]),
+        }
+    )
+    queries = _distributed_fuzz_queries(qrng, k=3)
+    kill_after = int(rng.integers(1, len(queries)))
+
+    oracle = _run_distributed(
+        table, queries, {"ballista.shuffle.partitions": "4"}
+    )
+
+    _fresh()
+    recovery_stats(reset=True)
+    cluster = StandaloneCluster(
+        n_executors=2,
+        n_schedulers=2,
+        config=BallistaConfig({
+            "ballista.scheduler.lease_ttl_s": "0.3",
+            "ballista.chaos.rate": "0.25",
+            "ballista.chaos.seed": str(90 + seed),
+            "ballista.chaos.sites": "scheduler.lease",
+        }),
+    )
+    try:
+        ctx = BallistaContext(
+            *cluster.scheduler_addr,
+            settings={"ballista.shuffle.partitions": "4"},
+            endpoints=cluster.scheduler_endpoints,
+        )
+        ctx.register_record_batches("t", table, n_partitions=4)
+        got = []
+        for i, sql in enumerate(queries):
+            if i == kill_after:
+                cluster.kill_scheduler(0)
+            got.append(ctx.sql(sql).collect())
+        ctx.close()
+    finally:
+        cluster.shutdown()
+
+    for sql, g, o in zip(queries, got, oracle):
+        assert g.equals(o), (seed, kill_after, sql,
+                             g.to_pydict(), o.to_pydict())
+    stats = recovery_stats(reset=True)
+    # the survivor finished every post-kill query without a single task
+    # re-execution: failover is a control-plane event, not a data redo
+    assert stats.get("task_retry", 0) == 0, stats

@@ -197,14 +197,16 @@ def test_recover_drops_resolved_ledger_entries(tmp_path):
 class _CrashAtWrite:
     """Chaos stub that raises on the k-th staged planning write — the
     'crash between each pair of planning keys' probe. Duck-types the one
-    injector method JobPlanBatch uses."""
+    injector method the planning path uses. The lease mint (ISSUE 20)
+    rides the same commit and counts as one more seam: crashing there
+    must be just as invisible as crashing between any other pair."""
 
     def __init__(self, k):
         self.k = k
         self.calls = 0
 
     def maybe_fail(self, site, key):
-        assert site == "scheduler.plan_write"
+        assert site in ("scheduler.plan_write", "kv.lease")
         self.calls += 1
         if self.calls == self.k:
             raise ChaosInjected(site, key)

@@ -63,6 +63,78 @@ def test_kv_lease_expiry(kv):
     assert kv.get_prefix("lease/") == []
 
 
+def test_kv_lease_and_fenced_cas_conformance(kv):
+    """ISSUE 20 lease + fenced-CAS contract, identical across all three
+    backends (the replicated control plane must behave the same over
+    memory, sqlite, and etcd)."""
+    import time
+
+    ttl, wait = (1, 1.15) if isinstance(kv, EtcdBackend) else (0.05, 0.1)
+
+    # lease_grant = TTL write; renew extends it past the original expiry
+    kv.lease_grant("leases/j1", b"owner-a", ttl)
+    assert kv.get("leases/j1") == b"owner-a"
+    for _ in range(2):
+        time.sleep(ttl * 0.6)
+        assert kv.lease_renew("leases/j1", ttl) is True
+    assert kv.get("leases/j1") == b"owner-a"  # renewals kept it alive
+    time.sleep(wait)
+    assert kv.get("leases/j1") is None
+    # renewing an expired (or never-granted) key refuses: the caller has
+    # been deposed and must not write as if it still held the lease
+    assert kv.lease_renew("leases/j1", ttl) is False
+    assert kv.lease_renew("leases/never", ttl) is False
+
+    # fenced CAS: matching guard lands the whole batch
+    kv.put("leases/j2", b"fence-1")
+    assert kv.put_all(
+        [("ledger/j2/a", b"x")], compare=("leases/j2", b"fence-1")
+    ) is True
+    assert kv.get("ledger/j2/a") == b"x"
+    # mismatched guard rejects the whole batch, writing nothing
+    assert kv.put_all(
+        [("ledger/j2/a", b"stale"), ("ledger/j2/b", b"stale")],
+        compare=("leases/j2", b"fence-0"),
+    ) is False
+    assert kv.get("ledger/j2/a") == b"x"
+    assert kv.get("ledger/j2/b") is None
+
+    # expect-absent (expected=None) claims exactly once
+    assert kv.put_all(
+        [("claimed/j3", b"by-a")], compare=("leases/j3", None)
+    ) is True
+    kv.put("leases/j3", b"fence-a")
+    assert kv.put_all(
+        [("claimed/j3", b"by-b")], compare=("leases/j3", None)
+    ) is False
+    assert kv.get("claimed/j3") == b"by-a"
+
+    # leases ride the batch atomically (minted WITH the commit) and expire
+    assert kv.put_all(
+        [("jobs/j4", b"queued")],
+        compare=("leases/j4", None),
+        leases=[("leases/j4", b"owner-a", ttl)],
+    ) is True
+    assert kv.get("leases/j4") == b"owner-a"
+    # ... and guard later fenced writes by value
+    assert kv.put_all(
+        [("ledger/j4/a", b"y")], compare=("leases/j4", b"owner-a")
+    ) is True
+    time.sleep(wait)
+    # an EXPIRED guard compares as absent: the fenced write of a live
+    # owner fails, and an expect-absent re-mint succeeds (lazy re-mint)
+    assert kv.put_all(
+        [("ledger/j4/b", b"z")], compare=("leases/j4", b"owner-a")
+    ) is False
+    assert kv.get("ledger/j4/b") is None
+    assert kv.put_all(
+        [("ledger/j4/b", b"z")],
+        compare=("leases/j4", None),
+        leases=[("leases/j4", b"owner-a2", ttl)],
+    ) is True
+    assert kv.get("ledger/j4/b") == b"z"
+
+
 def test_etcd_global_lock_mutual_exclusion():
     """Two clients of the same endpoint contend on /ballista_global_lock
     (ref etcd.rs:89-113): the critical sections must serialize."""

@@ -316,6 +316,25 @@ def test_failure_rule_delta_site_fixture_pair():
     assert good == [], "\n".join(f.format() for f in good)
 
 
+def test_failure_rule_replica_site_fixture_pair():
+    """ISSUE 20: the scheduler.lease and kv.lease sites are registered —
+    an unregistered renewal site and a computed lease site name fail lint;
+    the registered-literal shapes (generation/round-keyed verdicts BEFORE
+    any lease write) are clean."""
+    findings = [
+        f.message
+        for f in analyze_file(str(FIXTURES / "failure_replica_bad.py"))
+        if f.rule == "failure-discipline"
+    ]
+    assert any(
+        "unregistered chaos site" in m and "scheduler.renew" in m
+        for m in findings
+    ), findings
+    assert any("string literal" in m for m in findings), findings
+    good = analyze_file(str(FIXTURES / "failure_replica_good.py"))
+    assert good == [], "\n".join(f.format() for f in good)
+
+
 def test_routing_rule_fixture_pair():
     """ISSUE 10 satellite: a decline-helper call with no routing
     observation in scope and no cold-path annotation fails lint — a
